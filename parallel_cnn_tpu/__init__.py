@@ -1,0 +1,25 @@
+"""parallel_cnn_tpu — a TPU-native training framework with the capabilities of
+Tamerkobba/Parallel-CNN.
+
+The reference implements a hand-rolled LeNet-style CNN trainer (conv →
+trainable-pool → FC, sigmoid everywhere, per-sample SGD) four times over:
+Sequential C++, OpenMP, MPI and CUDA backends (see SURVEY.md). This package
+re-expresses those capabilities idiomatically for TPU:
+
+- ``data``     — idx-ubyte MNIST ingestion (NumPy + native C++ loader),
+                 synthetic fallback, sharded host→HBM batching.
+- ``ops``      — the per-layer forward/backward kernel library. Two paths:
+                 ``ops.reference`` (jax.numpy/lax, bit-faithful to the
+                 Sequential backend's numerics contract) and ``ops.pallas``
+                 (compiled Mosaic TPU kernels, the CUDA-backend analog).
+- ``models``   — the LeNet-ref parity model plus a growing model zoo.
+- ``parallel`` — mesh abstraction, data-parallel `shard_map` training,
+                 intra-op output-space decomposition (the MPI-backend analog),
+                 multi-host init (the `mpirun` analog).
+- ``train``    — jit-compiled train steps, epoch drivers, checkpointing.
+- ``utils``    — correct (block_until_ready) per-phase timing, metrics.
+"""
+
+__version__ = "0.1.0"
+
+from parallel_cnn_tpu.config import Config  # noqa: F401

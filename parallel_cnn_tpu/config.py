@@ -1,0 +1,77 @@
+"""Configuration layer.
+
+The reference has no config system: every tunable is a hardcoded constant —
+``dt = 0.1`` and ``threshold = 0.01`` (Sequential/layer.h:12-13), epochs via
+``iter = 1`` (Sequential/Main.cpp:148), data paths (Sequential/Main.cpp:38-41),
+and layer shapes baked into global ctor args (Sequential/Main.cpp:17-20).
+``argc/argv`` are accepted and ignored (Sequential/Main.cpp:44).
+
+Here every one of those constants becomes a config field, plus the TPU-native
+knobs the reference couldn't have (mesh shape, batching, dtype policy).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    """Where training data comes from (≙ Sequential/Main.cpp:36-42)."""
+
+    train_images: str = "data/train-images.idx3-ubyte"
+    train_labels: str = "data/train-labels.idx1-ubyte"
+    test_images: str = "data/t10k-images.idx3-ubyte"
+    test_labels: str = "data/t10k-labels.idx1-ubyte"
+    # The reference snapshot ships labels but not images (SURVEY.md B15);
+    # when files are missing we synthesize a deterministic MNIST stand-in.
+    synthetic_fallback: bool = True
+    synthetic_train_count: int = 60_000
+    synthetic_test_count: int = 10_000
+    synthetic_seed: int = 1234
+    loader: str = "auto"  # "auto" | "native" | "numpy" | "synthetic"
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Optimization contract of the reference (SURVEY.md §2.1)."""
+
+    # `dt` at Sequential/layer.h:12 — SGD step applied as `w += dt * g`.
+    dt: float = 0.1
+    # `threshold` at Sequential/layer.h:13 — stop when mean ‖y−ŷ‖₂ < threshold.
+    threshold: float = 0.01
+    # `iter` at Sequential/Main.cpp:148. The reference's while-loop caps at one
+    # epoch (bug B12); we honor the *intent*: run up to `epochs`, stop early
+    # at `threshold`.
+    epochs: int = 1
+    # batch_size=1 reproduces the reference's per-sample SGD trajectory
+    # (Sequential/Main.cpp:157-171). Larger batches are the TPU throughput
+    # mode (minibatch SGD; a deliberate, documented equivalence gap).
+    batch_size: int = 1
+    seed: int = 0
+    # dtype for the compute path. The reference is float32 throughout;
+    # bfloat16 is the MXU-native option for throughput runs.
+    dtype: str = "float32"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh layout (the TPU-native replacement for `mpirun -np N` +
+    per-kernel MPI_Reduce, MPI/Main.cpp:44 / MPI/layer.h)."""
+
+    # Axis sizes; None = use all available devices on that axis.
+    data: Optional[int] = None  # batch (DP) axis
+    model: int = 1  # intra-op / tensor axis
+    axis_names: Tuple[str, str] = ("data", "model")
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    model: str = "lenet_ref"
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)

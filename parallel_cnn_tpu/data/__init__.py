@@ -1,0 +1,17 @@
+from parallel_cnn_tpu.data.mnist import (  # noqa: F401
+    MnistError,
+    load_idx_images,
+    load_idx_labels,
+    load_pair,
+    write_idx_images,
+    write_idx_labels,
+)
+from parallel_cnn_tpu.data.pipeline import (  # noqa: F401
+    Dataset,
+    device_put_sharded_batch,
+    epoch_batches,
+    load_split,
+    load_train_test,
+    pad_to_batch,
+)
+from parallel_cnn_tpu.data.synthetic import make_dataset  # noqa: F401

@@ -1,0 +1,137 @@
+"""Dataset assembly + host→device batching (≙ loaddata(), Sequential/Main.cpp:36-42).
+
+The reference loads the full dataset to host RAM once, then feeds the model
+one sample at a time — in the CUDA backend this means a per-sample H2D
+`cudaMemcpy` 60k times per epoch (CUDA/layer.cu:60-63, SURVEY.md §3.2). Here
+the entire epoch tensor is placed in HBM once with `jax.device_put` (sharded
+over the mesh's data axis when one is given) and batches are sliced on-device.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from parallel_cnn_tpu.config import DataConfig
+from parallel_cnn_tpu.data import mnist, synthetic
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class Dataset:
+    """One split, fully materialized on host."""
+
+    images: np.ndarray  # (N, 28, 28) float32 in [0, 1]
+    labels: np.ndarray  # (N,) int32
+
+    def __len__(self) -> int:
+        return self.images.shape[0]
+
+
+def load_split(
+    cfg: DataConfig, images_path: str, labels_path: str, synth_count: int, seed: int
+) -> Dataset:
+    """Try real idx files; fall back to the deterministic synthetic set
+    (SURVEY.md B15: the reference snapshot has labels but no image blobs)."""
+    if cfg.loader == "synthetic":
+        imgs, labels = synthetic.make_dataset(synth_count, seed=seed)
+        return Dataset(imgs, labels)
+
+    def parse():
+        if cfg.loader == "native":
+            # Forced native: an unavailable extension is a typed error, not
+            # an ImportError leak (and never silently another parser).
+            try:
+                from parallel_cnn_tpu.data import native
+            except ImportError as ie:
+                raise mnist.MnistError(
+                    -5, f"native loader unavailable: {ie}"
+                ) from ie
+            return native.load_pair(images_path, labels_path)
+        if cfg.loader == "numpy":
+            return mnist.load_pair(images_path, labels_path)
+        # auto: prefer the native parser when built, else pure NumPy.
+        try:
+            from parallel_cnn_tpu.data import native
+        except ImportError:
+            return mnist.load_pair(images_path, labels_path)
+        return native.load_pair(images_path, labels_path)
+
+    try:
+        imgs, labels = parse()
+        return Dataset(imgs, labels)
+    except mnist.MnistError as e:
+        if not cfg.synthetic_fallback:
+            raise
+        log.warning(
+            "idx files unavailable (%s); using synthetic MNIST stand-in", e
+        )
+        imgs, labels = synthetic.make_dataset(synth_count, seed=seed)
+        return Dataset(imgs, labels)
+
+
+def load_train_test(cfg: DataConfig) -> Tuple[Dataset, Dataset]:
+    train = load_split(
+        cfg, cfg.train_images, cfg.train_labels, cfg.synthetic_train_count,
+        cfg.synthetic_seed,
+    )
+    test = load_split(
+        cfg, cfg.test_images, cfg.test_labels, cfg.synthetic_test_count,
+        cfg.synthetic_seed + 1,
+    )
+    return train, test
+
+
+def epoch_batches(
+    ds: Dataset,
+    batch_size: int,
+    *,
+    shuffle: bool = False,
+    seed: int = 0,
+    drop_remainder: bool = True,
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Host-side batch iterator. The reference never shuffles (it replays
+    file order every epoch, Sequential/Main.cpp:157); shuffle is opt-in."""
+    n = len(ds)
+    idx = np.arange(n)
+    if shuffle:
+        np.random.default_rng(seed).shuffle(idx)
+    end = n - (n % batch_size) if drop_remainder else n
+    for i in range(0, end, batch_size):
+        j = idx[i : i + batch_size]
+        yield ds.images[j], ds.labels[j]
+
+
+def pad_to_batch(
+    images: np.ndarray, labels: np.ndarray, batch_size: int
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Pad a ragged tail batch up to `batch_size`; returns the valid count."""
+    valid = images.shape[0]
+    if valid == batch_size:
+        return images, labels, valid
+    pad = batch_size - valid
+    images = np.concatenate([images, np.zeros((pad,) + images.shape[1:], images.dtype)])
+    labels = np.concatenate([labels, np.zeros((pad,), labels.dtype)])
+    return images, labels, valid
+
+
+def device_put_sharded_batch(batch, mesh=None, data_axis: str = "data"):
+    """Place a host batch into HBM, sharded along the mesh's data axis.
+
+    This is the framework's single host→device boundary (contrast: the CUDA
+    reference crosses it once per sample per epoch, SURVEY.md §3.2).
+    """
+    import jax
+
+    if mesh is None:
+        return jax.device_put(batch)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P(data_axis))
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), batch
+    )

@@ -1,0 +1,1 @@
+from parallel_cnn_tpu.models import lenet_ref  # noqa: F401

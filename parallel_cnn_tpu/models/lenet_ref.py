@@ -1,0 +1,44 @@
+"""The reference LeNet-style model as a params pytree.
+
+≙ the four global `Layer` objects (Sequential/Main.cpp:17-20):
+    l_input(0, 0, 28*28)     — input holder (here: just the array)
+    l_c1(5*5, 6, 24*24*6)    — conv, 6 filters 5×5          → (6, 24, 24)
+    l_s1(4*4, 1, 6*6*6)      — trainable pool, shared 4×4   → (6, 6, 6)
+    l_f(6*6*6, 10, 10)       — dense 216→10                 → (10,)
+
+Init contract (Sequential/layer.h:48-54): weights AND biases drawn from
+`0.5f − rand()/RAND_MAX`, i.e. uniform on [−0.5, 0.5] — reproduced here as
+`jax.random.uniform(minval=-0.5, maxval=0.5)`. Exact rand() replay is
+impossible and not required; distribution parity is the contract
+(SURVEY.md §2.1).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Dict[str, jax.Array]]
+
+SHAPES = {
+    "c1": {"w": (6, 5, 5), "b": (6,)},
+    "s1": {"w": (4, 4), "b": ()},
+    "f": {"w": (10, 216), "b": (10,)},
+}
+
+
+def init(key: jax.Array, dtype=jnp.float32) -> Params:
+    """U(−0.5, 0.5) init for every weight and bias (layer.h:48-54)."""
+    leaves, treedef = jax.tree_util.tree_flatten(SHAPES, is_leaf=lambda x: isinstance(x, tuple))
+    keys = jax.random.split(key, len(leaves))
+    inits = [
+        jax.random.uniform(k, shape, dtype=dtype, minval=-0.5, maxval=0.5)
+        for k, shape in zip(keys, leaves)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, inits)
+
+
+def num_params(params: Params) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,8 @@
+from parallel_cnn_tpu.ops.activations import (  # noqa: F401
+    apply_grad,
+    error_norm,
+    make_error,
+    sigmoid,
+    sigmoid_grad_from_preact,
+)
+from parallel_cnn_tpu.ops import reference  # noqa: F401

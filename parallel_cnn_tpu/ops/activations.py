@@ -1,0 +1,50 @@
+"""Activation + loss-gradient utilities (≙ Sequential/layer.h:81-101).
+
+The reference's "step_function" is a logistic sigmoid despite the name
+(Sequential/layer.h:81-83); `makeError` produces the (onehot − output) error
+vector fed directly into backprop as d_preact (layer.h:91-95); `apply_grad`
+is the `w += dt * g` SGD step (layer.h:97-101).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sigmoid(v: jax.Array) -> jax.Array:
+    """≙ step_function (Sequential/layer.h:81-83): 1/(1+exp(−v)).
+
+    jax.nn.sigmoid is the numerically-stable fused form XLA lowers well.
+    """
+    return jax.nn.sigmoid(v)
+
+
+def sigmoid_grad_from_preact(preact: jax.Array) -> jax.Array:
+    """σ′(preact) = σ·(1−σ), recomputed from preact exactly as the reference
+    backward kernels do (e.g. bp_preact_s1, Sequential/layer.h:265-266)."""
+    s = jax.nn.sigmoid(preact)
+    return s * (1.0 - s)
+
+
+def make_error(output: jax.Array, label: jax.Array, num_classes: int = 10) -> jax.Array:
+    """≙ makeError (Sequential/layer.h:91-95): err[i] = onehot(Y)[i] − output[i].
+
+    This is fed straight into backprop as dL/d(preact) of the final layer —
+    the reference never materializes a loss value.
+    """
+    return jax.nn.one_hot(label, num_classes, dtype=output.dtype) - output
+
+
+def error_norm(err: jax.Array) -> jax.Array:
+    """≙ vectorNorm (Sequential/Main.cpp:28-34): ‖err‖₂ — the training metric."""
+    return jnp.sqrt(jnp.sum(err * err))
+
+
+def apply_grad(params, grads, dt: float):
+    """≙ apply_grad (Sequential/layer.h:97-101): p += dt·g over a pytree.
+
+    The `+=` sign is correct because makeError already encodes (target −
+    output); grads here follow the same convention.
+    """
+    return jax.tree_util.tree_map(lambda p, g: p + dt * g, params, grads)

@@ -1,0 +1,230 @@
+"""Reference-semantics forward/backward ops (jax.numpy/lax path).
+
+This module is the parity surface: it reproduces the numerics contract of
+the reference's Sequential kernel library (SURVEY.md §2.1) exactly —
+including the parts that are NOT the true gradient of any loss:
+
+- the /576 normalization of the conv weight & bias grads
+  (bp_weight_c1 / bp_bias_c1, Sequential/layer.h:381,389,402,412),
+- the /216 normalization of the pool bias grad (bp_bias_s1, layer.h:304-316),
+- unnormalized FC grads (bp_weight_f, layer.h:214-227),
+- the (onehot − output) error vector used directly as d_preact of the final
+  layer with no σ′ factor (makeError, layer.h:91-95).
+
+Because of this, `jax.grad` of the forward pass would NOT reproduce the
+reference training trajectory; the backward here is hand-written to spec
+(SURVEY.md §7 "hard parts"), and exposed both as an explicit
+`reference_grads` function and as a `custom_vjp` so the op library still
+composes with JAX's functional transforms (vmap/jit/scan/shard_map).
+
+All ops are single-sample (mirroring the per-sample reference kernels);
+batching is `jax.vmap`, which XLA fuses into batched MXU convs — the
+TPU-native replacement for the reference's 60k-iteration hot loop.
+
+Shapes use channel-major layout like the reference:
+    x: (28, 28) → c1: (6, 24, 24) → s1: (6, 6, 6) → f: (10,)
+Weights: w_c1 (6, 5, 5), b_c1 (6,); w_s1 (4, 4), b_s1 (); w_f (10, 216),
+b_f (10,).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from parallel_cnn_tpu.ops.activations import (
+    error_norm,
+    make_error,
+    sigmoid,
+    sigmoid_grad_from_preact,
+)
+
+Params = Dict[str, Dict[str, jax.Array]]
+
+CONV_NORM = 24.0 * 24.0  # `d` in bp_weight_c1/bp_bias_c1 (layer.h:381,402)
+POOL_BIAS_NORM = 6.0 * 6.0 * 6.0  # `total_elements` in bp_bias_s1 (layer.h:304)
+
+
+class Activations(NamedTuple):
+    """Saved forward state — what the reference keeps in each Layer's
+    output/preact buffers between forward_pass and back_pass."""
+
+    x: jax.Array        # (28, 28)   l_input.output
+    pre_c1: jax.Array   # (6, 24, 24) l_c1.preact
+    out_c1: jax.Array   # (6, 24, 24) l_c1.output
+    pre_s1: jax.Array   # (6, 6, 6)   l_s1.preact
+    out_s1: jax.Array   # (6, 6, 6)   l_s1.output
+    pre_f: jax.Array    # (10,)       l_f.preact
+    out_f: jax.Array    # (10,)       l_f.output
+
+
+# ---------------------------------------------------------------------------
+# Forward kernels
+# ---------------------------------------------------------------------------
+
+
+def conv_c1_forward(x: jax.Array, w: jax.Array, b: jax.Array) -> jax.Array:
+    """≙ fp_c1 (Sequential/layer.h:105-140): valid 5×5 conv, 6 filters,
+    stride 1, + per-filter bias. (28,28)·(6,5,5) → (6,24,24).
+
+    Expressed as `lax.conv_general_dilated` so XLA lowers it onto the MXU
+    instead of the reference's 86k-MAC scalar loop nest.
+    """
+    # NCHW lhs (1,1,28,28), OIHW rhs (6,1,5,5) → (1,6,24,24)
+    out = lax.conv_general_dilated(
+        x[None, None, :, :],
+        w[:, None, :, :],
+        window_strides=(1, 1),
+        padding="VALID",
+    )
+    return out[0] + b[:, None, None]
+
+
+def pool_s1_forward(x: jax.Array, w: jax.Array, b: jax.Array) -> jax.Array:
+    """≙ fp_s1 (Sequential/layer.h:143-181): the reference's nonstandard
+    trainable "pooling" — ONE shared 4×4 kernel, stride 4, applied per
+    feature map, + a single scalar bias. (6,24,24)·(4,4) → (6,6,6).
+
+    A stride-4 window reshape + einsum: XLA turns this into one small
+    contraction, no gather needed (windows tile exactly, 24 = 6·4).
+    """
+    xw = x.reshape(6, 6, 4, 6, 4)  # [m, ox, i, oy, j] = x[m, 4ox+i, 4oy+j]
+    return jnp.einsum("mxiyj,ij->mxy", xw, w) + b
+
+
+def fc_forward(x: jax.Array, w: jax.Array, b: jax.Array) -> jax.Array:
+    """≙ fp_preact_f + fp_bias_f (Sequential/layer.h:184-211):
+    dense 216→10 dot products + bias."""
+    return w @ x.reshape(-1) + b
+
+
+def forward(params: Params, x: jax.Array) -> Activations:
+    """≙ forward_pass (Sequential/Main.cpp:59-105): conv→σ→pool→σ→FC→σ,
+    returning every preact/output buffer for the hand-written backward."""
+    pre_c1 = conv_c1_forward(x, params["c1"]["w"], params["c1"]["b"])
+    out_c1 = sigmoid(pre_c1)
+    pre_s1 = pool_s1_forward(out_c1, params["s1"]["w"], params["s1"]["b"])
+    out_s1 = sigmoid(pre_s1)
+    pre_f = fc_forward(out_s1, params["f"]["w"], params["f"]["b"])
+    out_f = sigmoid(pre_f)
+    return Activations(x, pre_c1, out_c1, pre_s1, out_s1, pre_f, out_f)
+
+
+def predict(params: Params, x: jax.Array) -> jax.Array:
+    """≙ classify (Sequential/Main.cpp:186-200): argmax over the 10 outputs."""
+    return jnp.argmax(forward(params, x).out_f)
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels — hand-written to the reference contract
+# ---------------------------------------------------------------------------
+
+
+def backward(params: Params, acts: Activations, label: jax.Array) -> Tuple[jax.Array, Params]:
+    """≙ makeError + back_pass (Sequential/Main.cpp:107-144,167).
+
+    Returns `(err_norm, grads)` where `grads` is a params-shaped pytree g
+    such that the reference's update is exactly `p += dt * g` for every
+    weight AND bias. The reference updates biases *inside* the backward
+    kernels (bp_bias_f layer.h:229-234, bp_bias_s1 :302-317, bp_bias_c1
+    :398-414) with the same `+= dt * (normalized grad)` form — folding them
+    into the grads pytree reproduces identical arithmetic while keeping the
+    op functionally pure for jit/vmap/shard_map.
+    """
+    w_f, w_s1 = params["f"]["w"], params["s1"]["w"]
+
+    # makeError (layer.h:91-95): d_preact_f = onehot(Y) − output
+    d_pre_f = make_error(acts.out_f, label)
+    err = error_norm(d_pre_f)  # vectorNorm (Main.cpp:28-34)
+
+    # bp_weight_f (layer.h:214-227): outer product, unnormalized
+    g_w_f = jnp.outer(d_pre_f, acts.out_s1.reshape(-1))
+    # bp_bias_f (layer.h:229-234): bias += dt * d_preact  ⇒ g = d_preact
+    g_b_f = d_pre_f
+
+    # bp_output_s1 (layer.h:237-257): Wᵀ · d_preact_f
+    d_out_s1 = (w_f.T @ d_pre_f).reshape(6, 6, 6)
+    # bp_preact_s1 (layer.h:260-270): × σ′(preact)
+    d_pre_s1 = d_out_s1 * sigmoid_grad_from_preact(acts.pre_s1)
+    # bp_weight_s1 (layer.h:272-300): correlate d_preact with conv output
+    # windows[m, x, i, y, j] = out_c1[m, 4x+i, 4y+j]
+    out_c1_windows = acts.out_c1.reshape(6, 6, 4, 6, 4)
+    g_w_s1 = jnp.einsum("mxy,mxiyj->ij", d_pre_s1, out_c1_windows)
+    # bp_bias_s1 (layer.h:302-317): bias += dt * sum/216 ⇒ g = mean
+    g_b_s1 = jnp.sum(d_pre_s1) / POOL_BIAS_NORM
+
+    # bp_output_c1 (layer.h:319-346): scatter pool grads back through the
+    # shared 4×4 kernel — an exact stride-4 "un-pool" since windows tile.
+    d_out_c1 = jnp.einsum("mxy,ij->mxiyj", d_pre_s1, w_s1).reshape(6, 24, 24)
+    # bp_preact_c1 (layer.h:348-369): × σ′(preact)
+    d_pre_c1 = d_out_c1 * sigmoid_grad_from_preact(acts.pre_c1)
+    # bp_weight_c1 (layer.h:371-395): /576-normalized correlation with input.
+    # patches[p, x, y] = x[x+i, y+j] for p = 5*i+j
+    patches = lax.conv_general_dilated_patches(
+        acts.x[None, None, :, :], (5, 5), (1, 1), "VALID"
+    )[0]  # (25, 24, 24)
+    g_w_c1 = (
+        jnp.einsum("mxy,pxy->mp", d_pre_c1, patches).reshape(6, 5, 5) / CONV_NORM
+    )
+    # bp_bias_c1 (layer.h:398-414): bias += dt * sum/576 ⇒ g = mean
+    g_b_c1 = jnp.sum(d_pre_c1, axis=(1, 2)) / CONV_NORM
+
+    grads: Params = {
+        "c1": {"w": g_w_c1, "b": g_b_c1},
+        "s1": {"w": g_w_s1, "b": g_b_s1},
+        "f": {"w": g_w_f, "b": g_b_f},
+    }
+    return err, grads
+
+
+def value_and_ref_grads(
+    params: Params, x: jax.Array, label: jax.Array
+) -> Tuple[jax.Array, Params]:
+    """One sample's (err-norm, reference grads): forward + hand-written
+    backward, the functional unit of the reference's per-sample loop
+    (Sequential/Main.cpp:157-171)."""
+    acts = forward(params, x)
+    return backward(params, acts, label)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper — reference backward as a JAX-differentiable op
+# ---------------------------------------------------------------------------
+
+
+@jax.custom_vjp
+def reference_loss(params: Params, x: jax.Array, label: jax.Array) -> jax.Array:
+    """‖onehot(y) − f(x)‖₂ with a custom VJP that returns the REFERENCE
+    grads (negated to match the descent convention of `jax.grad`).
+
+    `-jax.grad(reference_loss)(params, x, y)` == `value_and_ref_grads(...)[1]`
+    scaled by the incoming cotangent — so optax-style optimizers and the
+    strict-parity trainer share one op. The true gradient of this norm is
+    NOT what the reference computes (SURVEY.md §7); this VJP is the
+    reference's backward by fiat.
+    """
+    acts = forward(params, x)
+    return error_norm(make_error(acts.out_f, label))
+
+
+def _ref_loss_fwd(params, x, label):
+    acts = forward(params, x)
+    err, grads = backward(params, acts, label)
+    return err, (grads, x, label)
+
+
+def _ref_loss_bwd(res, ct):
+    grads, x, label = res
+    # Descent convention: loss decreases along −g, and the reference applies
+    # p += dt·g, so grad(loss) = −g (scaled by the cotangent).
+    neg = jax.tree_util.tree_map(lambda g: -ct * g, grads)
+    import numpy as np
+
+    zero_label = np.zeros(label.shape, dtype=jax.dtypes.float0)
+    return neg, jnp.zeros_like(x), zero_label
+
+
+reference_loss.defvjp(_ref_loss_fwd, _ref_loss_bwd)

@@ -1,0 +1,1 @@
+from parallel_cnn_tpu.train import step, trainer  # noqa: F401

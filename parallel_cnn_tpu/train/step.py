@@ -1,0 +1,79 @@
+"""Jit-compiled train steps (≙ the body of learn(), Sequential/Main.cpp:146-184).
+
+Two modes, per SURVEY.md §7 "hard parts":
+
+- **Strict parity** (`scan_epoch` / `sgd_step`): batch size 1, weights
+  updated after every sample — the reference's exact optimization
+  trajectory (Sequential/Main.cpp:157-171). On TPU the 60k-iteration Python
+  loop becomes ONE `lax.scan` inside jit: the whole epoch is a single XLA
+  program, no host round-trips.
+
+- **Throughput** (`batched_step`): per-sample reference grads computed with
+  `vmap`, averaged over the batch, one update per batch. This changes the
+  optimization trajectory (minibatch vs per-sample SGD) — a deliberate,
+  documented equivalence gap; it is the mode that feeds the MXU batched
+  convs and the data-parallel mesh path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from parallel_cnn_tpu.ops import reference as ops
+from parallel_cnn_tpu.ops.activations import apply_grad
+
+Params = ops.Params
+
+
+def sgd_step(params: Params, x: jax.Array, y: jax.Array, dt: float) -> Tuple[Params, jax.Array]:
+    """One per-sample step: forward → hand-written backward → p += dt·g
+    (≙ one iteration of the loop at Sequential/Main.cpp:157-171)."""
+    err, grads = ops.value_and_ref_grads(params, x, y)
+    return apply_grad(params, grads, dt), err
+
+
+@functools.partial(jax.jit, static_argnames=("dt",), donate_argnums=(0,))
+def scan_epoch(params: Params, images: jax.Array, labels: jax.Array, dt: float) -> Tuple[Params, jax.Array]:
+    """A full per-sample-SGD epoch as one `lax.scan` (strict parity mode).
+
+    Returns (params, mean err-norm) — the per-epoch metric printed by
+    learn() (`err /= train_cnt`, Sequential/Main.cpp:173-174).
+    """
+
+    def body(p, xy):
+        x, y = xy
+        p, err = sgd_step(p, x, y, dt)
+        return p, err
+
+    params, errs = jax.lax.scan(body, params, (images, labels))
+    return params, jnp.mean(errs)
+
+
+@functools.partial(jax.jit, static_argnames=("dt",), donate_argnums=(0,))
+def batched_step(params: Params, x: jax.Array, y: jax.Array, dt: float) -> Tuple[Params, jax.Array]:
+    """Minibatch step: vmapped reference grads, mean-reduced over the batch.
+
+    x: (B, 28, 28), y: (B,). The mean (not sum) keeps the effective step
+    size comparable to the per-sample mode across batch sizes.
+    """
+    errs, grads = jax.vmap(ops.value_and_ref_grads, in_axes=(None, 0, 0))(params, x, y)
+    mean_grads = jax.tree_util.tree_map(lambda g: jnp.mean(g, axis=0), grads)
+    return apply_grad(params, mean_grads, dt), jnp.mean(errs)
+
+
+@jax.jit
+def classify_batch(params: Params, x: jax.Array) -> jax.Array:
+    """≙ classify() (Sequential/Main.cpp:186-200), vectorized: argmax of the
+    10 sigmoid outputs for a batch of images."""
+    return jax.vmap(ops.predict, in_axes=(None, 0))(params, x)
+
+
+@jax.jit
+def error_count(params: Params, x: jax.Array, y: jax.Array) -> jax.Array:
+    """Misclassification count on a batch (≙ test()'s error accumulation,
+    Sequential/Main.cpp:202-211)."""
+    return jnp.sum(classify_batch(params, x) != y)

@@ -1,0 +1,125 @@
+"""Epoch drivers (≙ learn() / test(), Sequential/Main.cpp:146-214).
+
+Reproduces the reference's observable behavior — "Learning", per-epoch
+`error: %e` lines, threshold early-stop, final `Error Rate: %.2lf%%` — on
+top of jitted epoch programs, with correct (block_until_ready) timing
+instead of the reference's un-synced clock() spans (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from parallel_cnn_tpu.config import Config
+from parallel_cnn_tpu.data import pipeline
+from parallel_cnn_tpu.models import lenet_ref
+from parallel_cnn_tpu.train import step as step_lib
+from parallel_cnn_tpu.utils.timing import Stopwatch
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class TrainResult:
+    params: step_lib.Params
+    epoch_errors: List[float] = field(default_factory=list)
+    seconds: float = 0.0
+    stopped_early: bool = False
+
+
+def learn(
+    cfg: Config,
+    train: pipeline.Dataset,
+    params: Optional[step_lib.Params] = None,
+    verbose: bool = True,
+) -> TrainResult:
+    """≙ learn() (Sequential/Main.cpp:146-184): epoch loop with mean
+    err-norm metric and threshold early-stop.
+
+    batch_size == 1 → strict-parity scan (per-sample SGD, the reference
+    trajectory); batch_size > 1 → minibatch steps.
+    """
+    tc = cfg.train
+    if params is None:
+        params = lenet_ref.init(jax.random.key(tc.seed))
+    else:
+        # The jitted steps donate params' buffers to XLA; copy so the
+        # caller's pytree stays alive after training on device backends.
+        params = jax.tree_util.tree_map(jnp.array, params)
+    if verbose:
+        print("Learning")
+
+    result = TrainResult(params)
+    sw = Stopwatch()
+    if tc.batch_size == 1:
+        images = jnp.asarray(train.images)
+        labels = jnp.asarray(train.labels)
+
+    for _ in range(tc.epochs):
+        with sw:
+            if tc.batch_size == 1:
+                params, err = step_lib.scan_epoch(params, images, labels, tc.dt)
+            else:
+                errs, weights = [], []
+                # drop_remainder=False: the tail batch runs at its own
+                # (smaller) shape — one extra XLA compile, no dropped data.
+                for bx, by in pipeline.epoch_batches(
+                    train, tc.batch_size, drop_remainder=False
+                ):
+                    params, e = step_lib.batched_step(
+                        params, jnp.asarray(bx), jnp.asarray(by), tc.dt
+                    )
+                    errs.append(e)
+                    weights.append(bx.shape[0])
+                w = jnp.asarray(weights, jnp.float32)
+                err = jnp.sum(jnp.stack(errs) * w) / jnp.sum(w)
+            err = float(err)  # blocks: everything above is async
+        result.epoch_errors.append(err)
+        if verbose:
+            # ≙ fprintf at Sequential/Main.cpp:174
+            print(f"error: {err:e}, time_on_cpu: {sw.total:f}")
+        if err < tc.threshold:
+            result.stopped_early = True
+            if verbose:
+                # ≙ Sequential/Main.cpp:177
+                print("Training complete, error less than threshold\n")
+            break
+
+    result.params = params
+    result.seconds = sw.total
+    if verbose:
+        print(f"\n Time - {sw.total:f}")  # ≙ Sequential/Main.cpp:183
+    return result
+
+
+def test(
+    params: step_lib.Params,
+    test_ds: pipeline.Dataset,
+    batch_size: int = 1000,
+    verbose: bool = True,
+) -> float:
+    """≙ test() (Sequential/Main.cpp:202-214): % misclassified on the test
+    split, evaluated in on-device batches rather than per-sample."""
+    n = len(test_ds)
+    errors = 0
+    for i in range(0, n, batch_size):
+        x = jnp.asarray(test_ds.images[i : i + batch_size])
+        y = jnp.asarray(test_ds.labels[i : i + batch_size])
+        errors += int(step_lib.error_count(params, x, y))
+    rate = errors / n * 100.0
+    if verbose:
+        print(f"Error Rate: {rate:.2f}%")  # ≙ Sequential/Main.cpp:212-213
+    return rate
+
+
+def run(cfg: Config, verbose: bool = True) -> float:
+    """≙ main() (Sequential/Main.cpp:44-57): loaddata → learn → test."""
+    train_ds, test_ds = pipeline.load_train_test(cfg.data)
+    result = learn(cfg, train_ds, verbose=verbose)
+    return test(result.params, test_ds, verbose=verbose)

@@ -1,0 +1,1 @@
+from parallel_cnn_tpu.utils.timing import PhaseTimer, Stopwatch  # noqa: F401

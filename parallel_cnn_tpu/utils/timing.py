@@ -1,0 +1,64 @@
+"""Correct device-aware timing (fixes the reference's measurement bugs).
+
+The reference times with host `clock()` around kernel launches and never
+synchronizes the device — its CUDA numbers measure launch overhead, not GPU
+execution (CUDA/main.cu:71-107, SURVEY.md B11). Here every span end blocks
+on the traced value (`block_until_ready`) so wall-time covers actual device
+work, and per-phase accumulators (≙ total_convolution_time etc.,
+Sequential/Main.cpp:11) are first-class.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+import jax
+
+
+class Stopwatch:
+    """Accumulating wall-clock timer; use as a context manager per span."""
+
+    def __init__(self) -> None:
+        self.total = 0.0
+        self.spans = 0
+        self._t0: Optional[float] = None
+
+    def __enter__(self) -> "Stopwatch":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.total += time.perf_counter() - self._t0
+        self.spans += 1
+        self._t0 = None
+
+
+class PhaseTimer:
+    """Named per-phase accumulators (≙ the four totals at
+    Sequential/Main.cpp:11,51-54), but sync-correct: pass the phase's output
+    arrays to `stop` and the span blocks until they are actually computed."""
+
+    def __init__(self) -> None:
+        self.totals: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+
+    @contextmanager
+    def phase(self, name: str, result=None):
+        t0 = time.perf_counter()
+        out = {}
+        yield out
+        value = out.get("result", result)
+        if value is not None:
+            jax.block_until_ready(value)
+        self.totals[name] += time.perf_counter() - t0
+        self.counts[name] += 1
+
+    def report(self) -> str:
+        lines = [
+            f"Total {name} time: {ms * 1000.0:.3f} ms"
+            for name, ms in sorted(self.totals.items())
+        ]
+        return "\n".join(lines)

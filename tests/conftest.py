@@ -1,0 +1,33 @@
+"""Test environment: force an 8-device virtual CPU platform so sharding /
+multi-device tests run without TPU hardware (SURVEY.md §4's test-strategy
+note; the driver separately dry-runs the multi-chip path).
+
+The ambient environment registers the `axon` TPU platform via a
+sitecustomize hook that runs BEFORE this conftest, and jax's config snapshots
+JAX_PLATFORMS at that import — so mutating os.environ here is too late.
+`jax.config.update` is the reliable override, and it also keeps the suite
+hermetic when the tunneled TPU is unreachable.
+"""
+
+import os
+
+# XLA reads XLA_FLAGS at first backend init, which happens after conftest
+# import — env mutation still works for this one.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,148 @@
+"""Straight-loop NumPy oracle: an independent re-derivation of the reference
+Sequential kernels' numerics (SURVEY.md §2.1), used as ground truth for the
+JAX/Pallas op paths. Deliberately written as literal loop nests mirroring
+the contract described in SURVEY.md — NOT vectorized — so a bug in the fast
+path can't be mirrored here by construction.
+
+Validated against the intended semantics of Sequential/layer.h:105-414
+(fp_c1, fp_s1, fp_preact_f/fp_bias_f, bp_* and the bias-update rules).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+DT = 0.1
+
+
+def sigmoid(v):
+    return 1.0 / (1.0 + np.exp(-v))
+
+
+def forward(params, x):
+    w_c1, b_c1 = params["c1"]["w"], params["c1"]["b"]
+    w_s1, b_s1 = params["s1"]["w"], params["s1"]["b"]
+    w_f, b_f = params["f"]["w"], params["f"]["b"]
+
+    pre_c1 = np.zeros((6, 24, 24), np.float64)
+    for m in range(6):
+        for ox in range(24):
+            for oy in range(24):
+                s = 0.0
+                for i in range(5):
+                    for j in range(5):
+                        s += x[ox + i, oy + j] * w_c1[m, i, j]
+                pre_c1[m, ox, oy] = s + b_c1[m]
+    out_c1 = sigmoid(pre_c1)
+
+    pre_s1 = np.zeros((6, 6, 6), np.float64)
+    for m in range(6):
+        for ox in range(6):
+            for oy in range(6):
+                s = 0.0
+                for i in range(4):
+                    for j in range(4):
+                        s += w_s1[i, j] * out_c1[m, ox * 4 + i, oy * 4 + j]
+                pre_s1[m, ox, oy] = s + b_s1
+    out_s1 = sigmoid(pre_s1)
+
+    pre_f = np.zeros(10, np.float64)
+    flat = out_s1.reshape(-1)
+    for i in range(10):
+        pre_f[i] = np.dot(w_f[i], flat) + b_f[i]
+    out_f = sigmoid(pre_f)
+    return dict(
+        x=x, pre_c1=pre_c1, out_c1=out_c1, pre_s1=pre_s1, out_s1=out_s1,
+        pre_f=pre_f, out_f=out_f,
+    )
+
+
+def backward(params, acts, label):
+    """Returns (err_norm, grads) with grads in the `p += dt*g` convention —
+    bias grads already carry their reference normalizations."""
+    w_f, w_s1 = params["f"]["w"], params["s1"]["w"]
+    x, out_c1, out_s1 = acts["x"], acts["out_c1"], acts["out_s1"]
+    pre_c1, pre_s1 = acts["pre_c1"], acts["pre_s1"]
+
+    d_pre_f = np.zeros(10, np.float64)
+    for i in range(10):
+        d_pre_f[i] = (1.0 if i == label else 0.0) - acts["out_f"][i]
+    err = float(np.sqrt(np.sum(d_pre_f**2)))
+
+    g_w_f = np.zeros((10, 216), np.float64)
+    flat = out_s1.reshape(-1)
+    for i in range(10):
+        for j in range(216):
+            g_w_f[i, j] = d_pre_f[i] * flat[j]
+    g_b_f = d_pre_f.copy()
+
+    d_out_s1 = np.zeros((6, 6, 6), np.float64)
+    w_f_t = w_f.reshape(10, 6, 6, 6)
+    for i1 in range(10):
+        for a in range(6):
+            for b in range(6):
+                for c in range(6):
+                    d_out_s1[a, b, c] += w_f_t[i1, a, b, c] * d_pre_f[i1]
+    s = sigmoid(pre_s1)
+    d_pre_s1 = d_out_s1 * s * (1.0 - s)
+
+    g_w_s1 = np.zeros((4, 4), np.float64)
+    for i2 in range(4):
+        for i3 in range(4):
+            for m in range(6):
+                for a in range(6):
+                    for b in range(6):
+                        g_w_s1[i2, i3] += (
+                            d_pre_s1[m, a, b] * out_c1[m, a * 4 + i2, b * 4 + i3]
+                        )
+    g_b_s1 = float(np.sum(d_pre_s1)) / 216.0
+
+    d_out_c1 = np.zeros((6, 24, 24), np.float64)
+    for i2 in range(4):
+        for i3 in range(4):
+            for m in range(6):
+                for a in range(6):
+                    for b in range(6):
+                        d_out_c1[m, a * 4 + i2, b * 4 + i3] += (
+                            w_s1[i2, i3] * d_pre_s1[m, a, b]
+                        )
+    sc = sigmoid(pre_c1)
+    d_pre_c1 = d_out_c1 * sc * (1.0 - sc)
+
+    g_w_c1 = np.zeros((6, 5, 5), np.float64)
+    for m in range(6):
+        for i in range(5):
+            for j in range(5):
+                for a in range(24):
+                    for b in range(24):
+                        g_w_c1[m, i, j] += (
+                            d_pre_c1[m, a, b] * x[a + i, b + j] / 576.0
+                        )
+    g_b_c1 = np.zeros(6, np.float64)
+    for m in range(6):
+        g_b_c1[m] = np.sum(d_pre_c1[m]) / 576.0
+
+    grads = {
+        "c1": {"w": g_w_c1, "b": g_b_c1},
+        "s1": {"w": g_w_s1, "b": g_b_s1},
+        "f": {"w": g_w_f, "b": g_b_f},
+    }
+    return err, grads
+
+
+def sgd_update(params, grads):
+    """apply_grad + the in-backward bias updates: p += dt * g everywhere."""
+    out = {}
+    for layer in params:
+        out[layer] = {}
+        for k in params[layer]:
+            out[layer][k] = params[layer][k] + DT * np.asarray(grads[layer][k])
+    return out
+
+
+def random_params(rng):
+    return {
+        "c1": {"w": rng.uniform(-0.5, 0.5, (6, 5, 5)), "b": rng.uniform(-0.5, 0.5, 6)},
+        "s1": {"w": rng.uniform(-0.5, 0.5, (4, 4)), "b": float(rng.uniform(-0.5, 0.5))},
+        "f": {"w": rng.uniform(-0.5, 0.5, (10, 216)), "b": rng.uniform(-0.5, 0.5, 10)},
+    }

@@ -1,0 +1,81 @@
+"""Loader tests (≙ mnist.h's error-code surface + round-trip)."""
+
+import numpy as np
+import pytest
+
+from parallel_cnn_tpu.data import (
+    Dataset,
+    MnistError,
+    epoch_batches,
+    load_idx_images,
+    load_idx_labels,
+    load_pair,
+    make_dataset,
+    pad_to_batch,
+    write_idx_images,
+    write_idx_labels,
+)
+
+
+def test_idx_roundtrip(tmp_path, rng):
+    imgs = rng.uniform(0, 1, (17, 28, 28)).astype(np.float32)
+    labels = rng.integers(0, 10, 17).astype(np.int32)
+    ip, lp = str(tmp_path / "i.idx3-ubyte"), str(tmp_path / "l.idx1-ubyte")
+    write_idx_images(ip, imgs)
+    write_idx_labels(lp, labels)
+    got_i, got_l = load_pair(ip, lp)
+    assert got_i.shape == (17, 28, 28)
+    np.testing.assert_allclose(got_i, np.round(imgs * 255) / 255.0, atol=1e-6)
+    np.testing.assert_array_equal(got_l, labels)
+
+
+def test_missing_file_is_code_minus_1(tmp_path):
+    with pytest.raises(MnistError) as e:
+        load_idx_images(str(tmp_path / "nope"))
+    assert e.value.code == -1  # ≙ mnist.h:96 "No such files"
+
+
+def test_bad_magic_is_code_minus_2(tmp_path):
+    p = tmp_path / "bad"
+    p.write_bytes(b"\x00\x00\x00\x07" + b"\x00" * 16)
+    with pytest.raises(MnistError) as e:
+        load_idx_images(str(p))
+    assert e.value.code == -2  # ≙ mnist.h:102 "Not a valid image file"
+
+
+def test_label_magic_is_code_minus_3(tmp_path):
+    p = tmp_path / "bad"
+    p.write_bytes(b"\x00\x00\x00\x07" + b"\x00" * 8)
+    with pytest.raises(MnistError) as e:
+        load_idx_labels(str(p))
+    assert e.value.code == -3
+
+
+def test_count_mismatch_is_code_minus_4(tmp_path, rng):
+    ip, lp = str(tmp_path / "i"), str(tmp_path / "l")
+    write_idx_images(ip, rng.uniform(0, 1, (5, 28, 28)).astype(np.float32))
+    write_idx_labels(lp, np.arange(6) % 10)
+    with pytest.raises(MnistError) as e:
+        load_pair(ip, lp)
+    assert e.value.code == -4  # ≙ mnist.h:119 count mismatch
+
+
+def test_synthetic_deterministic():
+    a_i, a_l = make_dataset(64, seed=7)
+    b_i, b_l = make_dataset(64, seed=7)
+    np.testing.assert_array_equal(a_i, b_i)
+    np.testing.assert_array_equal(a_l, b_l)
+    assert a_i.shape == (64, 28, 28) and a_i.dtype == np.float32
+    assert a_i.min() >= 0.0 and a_i.max() <= 1.0
+    assert set(np.unique(a_l)) <= set(range(10))
+
+
+def test_epoch_batches_and_padding(rng):
+    ds = Dataset(
+        rng.uniform(0, 1, (10, 28, 28)).astype(np.float32),
+        rng.integers(0, 10, 10).astype(np.int32),
+    )
+    batches = list(epoch_batches(ds, 4))
+    assert len(batches) == 2  # drop_remainder
+    x, y, valid = pad_to_batch(ds.images[8:], ds.labels[8:], 4)
+    assert x.shape[0] == 4 and valid == 2

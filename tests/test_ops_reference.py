@@ -1,0 +1,95 @@
+"""Differential tests: JAX reference-ops path vs the straight-loop NumPy
+oracle (SURVEY.md §4's 'parity tests vs a NumPy re-derivation')."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import oracle
+from parallel_cnn_tpu.ops import reference as ops
+from parallel_cnn_tpu.ops.activations import apply_grad
+
+
+def to_jax_params(p):
+    return jax.tree_util.tree_map(lambda a: jnp.asarray(a, jnp.float32), p)
+
+
+@pytest.fixture(scope="module")
+def sample(rng):
+    return oracle.random_params(rng), rng.uniform(0.0, 1.0, (28, 28)), 3
+
+
+def test_forward_matches_oracle(sample, rng):
+    params, x, _ = sample
+    want = oracle.forward(params, x)
+    got = ops.forward(to_jax_params(params), jnp.asarray(x, jnp.float32))
+    np.testing.assert_allclose(got.pre_c1, want["pre_c1"], rtol=0, atol=1e-4)
+    np.testing.assert_allclose(got.out_c1, want["out_c1"], rtol=0, atol=1e-5)
+    np.testing.assert_allclose(got.pre_s1, want["pre_s1"], rtol=0, atol=1e-4)
+    np.testing.assert_allclose(got.out_s1, want["out_s1"], rtol=0, atol=1e-5)
+    np.testing.assert_allclose(got.pre_f, want["pre_f"], rtol=0, atol=1e-4)
+    np.testing.assert_allclose(got.out_f, want["out_f"], rtol=0, atol=1e-5)
+
+
+def test_backward_matches_oracle(sample):
+    params, x, label = sample
+    acts = oracle.forward(params, x)
+    want_err, want_g = oracle.backward(params, acts, label)
+
+    jp = to_jax_params(params)
+    got_err, got_g = ops.value_and_ref_grads(jp, jnp.asarray(x, jnp.float32), label)
+    assert abs(float(got_err) - want_err) < 1e-5
+    for layer in ("c1", "s1", "f"):
+        for k in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(got_g[layer][k]), np.asarray(want_g[layer][k]),
+                rtol=0, atol=2e-4, err_msg=f"grad {layer}/{k}",
+            )
+
+
+def test_sgd_step_matches_oracle(sample):
+    params, x, label = sample
+    acts = oracle.forward(params, x)
+    _, g = oracle.backward(params, acts, label)
+    want = oracle.sgd_update(params, g)
+
+    jp = to_jax_params(params)
+    _, got_g = ops.value_and_ref_grads(jp, jnp.asarray(x, jnp.float32), label)
+    got = apply_grad(jp, got_g, 0.1)
+    for layer in ("c1", "s1", "f"):
+        for k in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(got[layer][k]), np.asarray(want[layer][k]),
+                rtol=0, atol=2e-4, err_msg=f"update {layer}/{k}",
+            )
+
+
+def test_custom_vjp_equals_explicit_grads(sample):
+    """-grad(reference_loss) must equal the explicit reference grads."""
+    params, x, label = sample
+    jp = to_jax_params(params)
+    xj = jnp.asarray(x, jnp.float32)
+    _, explicit = ops.value_and_ref_grads(jp, xj, label)
+    via_grad = jax.grad(ops.reference_loss)(jp, xj, jnp.asarray(label))
+    for layer in ("c1", "s1", "f"):
+        for k in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(via_grad[layer][k]), -np.asarray(explicit[layer][k]),
+                rtol=0, atol=1e-6,
+            )
+
+
+def test_vmap_batches_grads(sample, rng):
+    """vmapped per-sample grads == stacked single-sample grads."""
+    params, _, _ = sample
+    jp = to_jax_params(params)
+    xs = jnp.asarray(rng.uniform(0, 1, (4, 28, 28)), jnp.float32)
+    ys = jnp.asarray([0, 3, 7, 9])
+    errs, grads = jax.vmap(ops.value_and_ref_grads, in_axes=(None, 0, 0))(jp, xs, ys)
+    for i in range(4):
+        err_i, g_i = ops.value_and_ref_grads(jp, xs[i], ys[i])
+        assert abs(float(errs[i]) - float(err_i)) < 1e-6
+        np.testing.assert_allclose(
+            np.asarray(grads["c1"]["w"][i]), np.asarray(g_i["c1"]["w"]), atol=1e-6
+        )

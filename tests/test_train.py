@@ -1,0 +1,76 @@
+"""Training-loop tests: strict-parity scan vs explicit per-sample loop, and
+the convergence-as-test integration check (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from parallel_cnn_tpu.config import Config, DataConfig, TrainConfig
+from parallel_cnn_tpu.data import Dataset, make_dataset
+from parallel_cnn_tpu.models import lenet_ref
+from parallel_cnn_tpu.train import step as step_lib
+from parallel_cnn_tpu.train import trainer
+
+
+def small_data(n=64, seed=0):
+    imgs, labels = make_dataset(n, seed=seed)
+    return jnp.asarray(imgs), jnp.asarray(labels)
+
+
+def test_scan_epoch_equals_python_loop():
+    """The lax.scan epoch must reproduce the eager per-sample loop exactly —
+    the reference trajectory (Sequential/Main.cpp:157-171) in one program."""
+    params = lenet_ref.init(jax.random.key(0))
+    xs, ys = small_data(16)
+
+    p_loop = params
+    errs = []
+    for i in range(16):
+        p_loop, e = step_lib.sgd_step(p_loop, xs[i], ys[i], 0.1)
+        errs.append(float(e))
+
+    p_scan, mean_err = step_lib.scan_epoch(params, xs, ys, 0.1)
+    assert abs(float(mean_err) - np.mean(errs)) < 1e-5
+    for la in ("c1", "s1", "f"):
+        for k in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(p_scan[la][k]), np.asarray(p_loop[la][k]),
+                rtol=0, atol=1e-5,
+            )
+
+
+def test_batched_step_reduces_error():
+    params = lenet_ref.init(jax.random.key(1))
+    xs, ys = small_data(256, seed=3)
+    first = None
+    for _ in range(30):
+        params, err = step_lib.batched_step(params, xs, ys, 0.5)
+        if first is None:
+            first = float(err)
+    assert float(err) < first
+
+
+def test_learn_and_test_integration():
+    """End-to-end: learn() on a small synthetic set must beat chance by a
+    wide margin (accuracy-as-test, ≙ Sequential/Main.cpp:202-214)."""
+    cfg = Config(
+        data=DataConfig(loader="synthetic", synthetic_train_count=2000,
+                        synthetic_test_count=500),
+        train=TrainConfig(epochs=1, batch_size=1),
+    )
+    train_imgs, train_labels = make_dataset(2000, seed=11)
+    test_imgs, test_labels = make_dataset(500, seed=12)
+    res = trainer.learn(cfg, Dataset(train_imgs, train_labels), verbose=False)
+    assert len(res.epoch_errors) >= 1
+    rate = trainer.test(res.params, Dataset(test_imgs, test_labels), verbose=False)
+    assert rate < 50.0  # chance is 90%
+
+
+def test_threshold_early_stop():
+    """err < threshold must stop the epoch loop (Sequential/Main.cpp:176-179)."""
+    cfg = Config(train=TrainConfig(epochs=50, threshold=1e9))
+    xs, ys = small_data(8)
+    res = trainer.learn(
+        cfg, Dataset(np.asarray(xs), np.asarray(ys)), verbose=False
+    )
+    assert res.stopped_early and len(res.epoch_errors) == 1
