@@ -1,0 +1,17 @@
+"""Parallelism layer: device meshes, data-parallel and intra-op (model)
+sharded training — the TPU-native counterpart of the reference's OpenMP /
+MPI / CUDA backends (SURVEY.md §2.3, §2.4)."""
+
+from parallel_cnn_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    batch_sharding,
+    distributed_init,
+    make_mesh,
+    pad_to_multiple,
+    replicate,
+    replicated,
+    shard_batch,
+    single_device_mesh,
+)
+from parallel_cnn_tpu.parallel import data_parallel, intra_op  # noqa: F401

@@ -1,0 +1,114 @@
+"""Data-parallel training over the mesh's ``data`` axis.
+
+This is the TPU-native realization of what the reference's MPI backend was
+*meant* to be (SURVEY.md §2.3): the BASELINE.json north star describes
+"batch-partition + gradient MPI_Allreduce"; the actual MPI code instead
+partitions each kernel's output index space and root-reduces 16 times per
+sample (MPI/layer.h:195,…,727) with no broadcast back (bug B7). Here:
+
+- the epoch tensor is sharded once over the data axis (one H2D transfer,
+  not 60k — contrast CUDA/layer.cu:60-63),
+- each device computes reference-contract grads on its local shard via the
+  same single-sample ops, vmapped,
+- ONE `psum` per step reduces the grad pytree over ICI — a true allreduce,
+  so every device holds identical updated params (B7 impossible),
+- the whole step is a single jitted shard_map program; XLA overlaps the
+  collective with compute where profitable.
+
+Semantics note (SURVEY.md §7 "hard parts"): DP is minibatch SGD — it cannot
+reproduce the reference's per-sample update trajectory, which is inherently
+sequential. The strict-parity path stays on one device
+(train/step.py:scan_epoch); DP is the throughput mode.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from parallel_cnn_tpu.ops import reference as ops
+from parallel_cnn_tpu.ops.activations import apply_grad
+from parallel_cnn_tpu.parallel.mesh import DATA_AXIS
+
+Params = ops.Params
+
+
+def _local_grads(params: Params, x: jax.Array, y: jax.Array):
+    """Per-device shard: vmapped reference grads, summed over local batch."""
+    errs, grads = jax.vmap(ops.value_and_ref_grads, in_axes=(None, 0, 0))(params, x, y)
+    sum_grads = jax.tree_util.tree_map(lambda g: jnp.sum(g, axis=0), grads)
+    return jnp.sum(errs), sum_grads
+
+
+def _dp_update(params: Params, x: jax.Array, y: jax.Array, dt: float, global_batch: int):
+    """One DP update on a device's shard (runs inside shard_map): local
+    reference grads → ONE psum over ICI (≙ the MPI backend's 16 root-only
+    reduces per SAMPLE, MPI/layer.h) → mean → `p += dt·g`. psum also
+    broadcasts, so every device ends the step with identical params."""
+    err_sum, grad_sum = _local_grads(params, x, y)
+    err_sum = jax.lax.psum(err_sum, DATA_AXIS)
+    grad_sum = jax.lax.psum(grad_sum, DATA_AXIS)
+    mean_grads = jax.tree_util.tree_map(lambda g: g / global_batch, grad_sum)
+    return apply_grad(params, mean_grads, dt), err_sum / global_batch
+
+
+def make_dp_step(mesh: Mesh, dt: float, global_batch: int):
+    """Build the jitted DP train step for a fixed global batch size.
+
+    Returns step(params, x, y) -> (params, mean_err) where x:(B,28,28) and
+    y:(B,) are sharded over the data axis and params are replicated.
+    """
+
+    def shard_body(params: Params, x: jax.Array, y: jax.Array):
+        return _dp_update(params, x, y, dt, global_batch)
+
+    sharded = jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_dp_eval(mesh: Mesh):
+    """Sharded misclassification count: each device classifies its shard of
+    the test set, psum the error count (≙ test(), Sequential/Main.cpp:202-211)."""
+
+    def shard_body(params: Params, x: jax.Array, y: jax.Array):
+        pred = jax.vmap(ops.predict, in_axes=(None, 0))(params, x)
+        return jax.lax.psum(jnp.sum(pred != y), DATA_AXIS)
+
+    sharded = jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(),
+    )
+    return jax.jit(sharded)
+
+
+def make_dp_epoch(mesh: Mesh, dt: float, global_batch: int):
+    """A full DP epoch as one jitted lax.scan over pre-sharded batches.
+
+    images: (S, B, 28, 28), labels: (S, B) with the B axis sharded over
+    ``data`` — the whole epoch runs on-device with no host round-trips,
+    the batched counterpart of train/step.py:scan_epoch.
+    """
+
+    def shard_body(params: Params, images: jax.Array, labels: jax.Array):
+        def body(p, xy):
+            x, y = xy
+            return _dp_update(p, x, y, dt, global_batch)
+
+        params, errs = jax.lax.scan(body, params, (images, labels))
+        return params, jnp.mean(errs)
+
+    sharded = jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(), P(None, DATA_AXIS), P(None, DATA_AXIS)),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(sharded, donate_argnums=(0,))

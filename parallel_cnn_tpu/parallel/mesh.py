@@ -1,0 +1,113 @@
+"""Device-mesh abstraction — the TPU-native substrate replacing the
+reference's two distribution runtimes (SURVEY.md §2.4):
+
+- `mpirun -np N` + per-kernel `MPI_Reduce(root=0)` (MPI/Main.cpp:44,
+  MPI/layer.h — 16 reduce sites), and
+- CUDA's single-device launch geometry (CUDA/main.cu:75-156).
+
+Here a single `jax.sharding.Mesh` with named axes carries both roles:
+the ``data`` axis is batch/data parallelism (what the MPI backend *wanted*
+to be), the ``model`` axis is intra-op decomposition (what it actually was,
+per kernel). Collectives compile onto ICI; nothing is root-biased, so the
+reference's "non-root ranks silently diverge" defect (SURVEY.md B7) cannot
+exist by construction.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from parallel_cnn_tpu.config import MeshConfig
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(cfg: Optional[MeshConfig] = None, devices: Optional[Sequence] = None) -> Mesh:
+    """Build the (data, model) mesh from config.
+
+    ``cfg.data=None`` means "all devices not claimed by the model axis" —
+    the moral equivalent of mpirun's -np defaulting to world size.
+    """
+    cfg = cfg or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    model = cfg.model
+    if cfg.data is None:
+        if n % model != 0:
+            raise ValueError(f"model axis {model} does not divide device count {n}")
+        data = n // model
+    else:
+        data = cfg.data
+        if data * model > n:
+            raise ValueError(
+                f"requested mesh {data}x{model} needs {data * model} devices "
+                f"but only {n} available"
+            )
+    dev_array = np.array(devices[: data * model]).reshape(data, model)
+    return Mesh(dev_array, cfg.axis_names)
+
+
+def single_device_mesh(device=None) -> Mesh:
+    """A 1×1 mesh: lets every code path be written mesh-first and still run
+    on one chip (≙ the Sequential/CUDA single-process backends)."""
+    device = device or jax.devices()[0]
+    return Mesh(np.array([device]).reshape(1, 1), (DATA_AXIS, MODEL_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-axis sharding over the data axis — how epoch tensors land in
+    HBM (contrast: the CUDA reference's 60k per-sample H2D memcpys,
+    SURVEY.md §3.2)."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated sharding (params in pure-DP training)."""
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch):
+    """Place a host batch in HBM sharded over the data axis."""
+    s = batch_sharding(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, s), batch)
+
+
+def replicate(mesh: Mesh, tree):
+    """Place a pytree in HBM replicated over the whole mesh.
+
+    Always copies: device_put may alias the source buffer when it already
+    lives on a mesh device, and the train steps donate their params — an
+    aliased replica would silently delete the caller's pytree.
+    """
+    s = replicated(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(jnp.array(x), s), tree)
+
+
+def pad_to_multiple(n: int, k: int) -> int:
+    """Smallest multiple of k ≥ n (batch padding for even data-axis shards)."""
+    return k * math.ceil(n / k)
+
+
+def distributed_init(coordinator: Optional[str] = None, num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Multi-host bring-up (≙ MPI_Init, MPI/Main.cpp:44).
+
+    On a TPU pod slice all arguments are auto-detected from the environment;
+    explicit args support manual bring-up. Safe to call when already
+    initialized (unlike MPI_Init). The reference's MPI_Finalize is dead code
+    after `return` (bug B8); JAX needs no finalize at all.
+
+    Genuine bring-up failures (bad coordinator, barrier timeout) propagate —
+    failing fast like MPI_Init, not silently degrading to single-process.
+    """
+    state = getattr(jax.distributed, "global_state", None)
+    if state is not None and getattr(state, "client", None) is not None:
+        return  # already initialized — idempotent by design
+    jax.distributed.initialize(coordinator, num_processes, process_id)

@@ -1,0 +1,154 @@
+"""Multi-device tests on the 8-device virtual CPU mesh (conftest.py).
+
+Differential strategy per SURVEY.md §4: the sharded paths must produce the
+SAME numbers as the single-device batched path to fp tolerance — the
+correctness property the reference's MPI backend never had (bugs B1-B7).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from parallel_cnn_tpu.config import MeshConfig
+from parallel_cnn_tpu.models import lenet_ref
+from parallel_cnn_tpu.ops import reference as ops
+from parallel_cnn_tpu.parallel import data_parallel, intra_op, mesh as mesh_lib
+from parallel_cnn_tpu.train import step as step_lib
+
+
+@pytest.fixture(scope="module")
+def params():
+    return lenet_ref.init(jax.random.key(7))
+
+
+@pytest.fixture(scope="module")
+def batch(rng_mod):
+    x = rng_mod.uniform(0, 1, size=(16, 28, 28)).astype(np.float32)
+    y = rng_mod.integers(0, 10, size=(16,)).astype(np.int32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+@pytest.fixture(scope="module")
+def rng_mod():
+    return np.random.default_rng(123)
+
+
+def tree_allclose(a, b, atol=1e-5):
+    flat_a = jax.tree_util.tree_leaves(a)
+    flat_b = jax.tree_util.tree_leaves(b)
+    for la, lb in zip(flat_a, flat_b):
+        np.testing.assert_allclose(la, lb, atol=atol, rtol=1e-5)
+
+
+class TestMesh:
+    def test_make_mesh_default_uses_all_devices(self):
+        m = mesh_lib.make_mesh()
+        assert m.devices.size == len(jax.devices())
+        assert m.axis_names == ("data", "model")
+
+    def test_make_mesh_2d(self):
+        m = mesh_lib.make_mesh(MeshConfig(model=2))
+        assert m.shape["model"] == 2
+        assert m.shape["data"] == len(jax.devices()) // 2
+
+    def test_model_axis_must_divide(self):
+        with pytest.raises(ValueError):
+            mesh_lib.make_mesh(MeshConfig(model=3))
+
+    def test_explicit_data_allows_subset_mesh(self):
+        # 8 devices, 2×3 mesh: legal — uses 6 of 8 devices.
+        m = mesh_lib.make_mesh(MeshConfig(data=2, model=3))
+        assert m.shape == {"data": 2, "model": 3}
+
+    def test_oversubscribed_mesh_raises(self):
+        with pytest.raises(ValueError, match="needs 16 devices"):
+            mesh_lib.make_mesh(MeshConfig(data=8, model=2))
+
+    def test_single_device_mesh(self):
+        m = mesh_lib.single_device_mesh()
+        assert m.devices.size == 1
+
+
+class TestDataParallel:
+    def test_dp_step_matches_single_device(self, params, batch):
+        x, y = batch
+        m = mesh_lib.make_mesh()  # 8×1
+
+        ref_params, ref_err = step_lib.batched_step(
+            jax.tree_util.tree_map(jnp.copy, params), x, y, 0.1
+        )
+
+        step = data_parallel.make_dp_step(m, 0.1, global_batch=x.shape[0])
+        p = mesh_lib.replicate(m, params)
+        xs, ys = mesh_lib.shard_batch(m, (x, y))
+        dp_params, dp_err = step(p, xs, ys)
+
+        np.testing.assert_allclose(float(dp_err), float(ref_err), atol=1e-5)
+        tree_allclose(dp_params, ref_params)
+
+    def test_dp_eval_matches_single_device(self, params, batch):
+        x, y = batch
+        m = mesh_lib.make_mesh()
+        ref_errs = int(step_lib.error_count(params, x, y))
+        ev = data_parallel.make_dp_eval(m)
+        p = mesh_lib.replicate(m, params)
+        xs, ys = mesh_lib.shard_batch(m, (x, y))
+        assert int(ev(p, xs, ys)) == ref_errs
+
+    def test_dp_epoch_matches_sequential_batched_steps(self, params, batch):
+        x, y = batch
+        m = mesh_lib.make_mesh()
+        steps, bsz = 2, 8
+        xs = x.reshape(steps, bsz, 28, 28)
+        ys = y.reshape(steps, bsz)
+
+        ref_p = jax.tree_util.tree_map(jnp.copy, params)
+        ref_errs = []
+        for i in range(steps):
+            ref_p, e = step_lib.batched_step(ref_p, xs[i], ys[i], 0.1)
+            ref_errs.append(float(e))
+
+        epoch = data_parallel.make_dp_epoch(m, 0.1, global_batch=bsz)
+        p = mesh_lib.replicate(m, params)
+        dp_p, err = epoch(p, jax.device_put(xs), jax.device_put(ys))
+        np.testing.assert_allclose(float(err), np.mean(ref_errs), atol=1e-5)
+        tree_allclose(dp_p, ref_p)
+
+
+class TestIntraOp:
+    @pytest.mark.parametrize("model_axis", [1, 2])
+    def test_2d_step_matches_single_device(self, params, batch, model_axis):
+        x, y = batch
+        m = mesh_lib.make_mesh(MeshConfig(model=model_axis))
+
+        ref_params, ref_err = step_lib.batched_step(
+            jax.tree_util.tree_map(jnp.copy, params), x, y, 0.1
+        )
+
+        step = intra_op.make_2d_step(m, 0.1, global_batch=x.shape[0])
+        p = intra_op.shard_params(m, params)
+        xs, ys = mesh_lib.shard_batch(m, (x, y))
+        tp_params, tp_err = step(p, xs, ys)
+
+        np.testing.assert_allclose(float(tp_err), float(ref_err), atol=1e-5)
+        tree_allclose(tp_params, ref_params)
+
+    def test_2d_forward_matches_reference(self, params, batch):
+        x, _ = batch
+        m = mesh_lib.make_mesh(MeshConfig(model=2))
+        fwd = intra_op.make_2d_forward(m)
+        p = intra_op.shard_params(m, params)
+        out = fwd(p, mesh_lib.shard_batch(m, x))
+        ref = jax.vmap(lambda s: ops.forward(params, s).out_f)(x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_param_shardings_layout(self, params):
+        m = mesh_lib.make_mesh(MeshConfig(model=2))
+        p = intra_op.shard_params(m, params)
+        # conv filters split over model: each shard holds 3 of 6 filters.
+        c1_spec = p["c1"]["w"].sharding.spec
+        assert c1_spec == P("model")
+        f_spec = p["f"]["w"].sharding.spec
+        assert f_spec == P(None, "model")
