@@ -13,7 +13,7 @@ knobs the reference couldn't have (mesh shape, batching, dtype policy).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Optional
 
 
 @dataclasses.dataclass(frozen=True)
@@ -58,12 +58,12 @@ class TrainConfig:
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh layout (the TPU-native replacement for `mpirun -np N` +
-    per-kernel MPI_Reduce, MPI/Main.cpp:44 / MPI/layer.h)."""
+    per-kernel MPI_Reduce, MPI/Main.cpp:44 / MPI/layer.h). Axis names are
+    fixed ("data", "model") — every collective in parallel/ binds them."""
 
     # Axis sizes; None = use all available devices on that axis.
     data: Optional[int] = None  # batch (DP) axis
     model: int = 1  # intra-op / tensor axis
-    axis_names: Tuple[str, str] = ("data", "model")
 
 
 @dataclasses.dataclass(frozen=True)

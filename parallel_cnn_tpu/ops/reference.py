@@ -90,8 +90,11 @@ def pool_s1_forward(x: jax.Array, w: jax.Array, b: jax.Array) -> jax.Array:
 
     A stride-4 window reshape + einsum: XLA turns this into one small
     contraction, no gather needed (windows tile exactly, 24 = 6·4).
+
+    Generic over the channel count so the model-sharded path
+    (parallel/intra_op.py) can call it on a channel shard.
     """
-    xw = x.reshape(6, 6, 4, 6, 4)  # [m, ox, i, oy, j] = x[m, 4ox+i, 4oy+j]
+    xw = x.reshape(x.shape[0], 6, 4, 6, 4)  # [m, ox, i, oy, j] = x[m, 4ox+i, 4oy+j]
     return jnp.einsum("mxiyj,ij->mxy", xw, w) + b
 
 

@@ -60,7 +60,15 @@ def make_dp_step(mesh: Mesh, dt: float, global_batch: int):
     y:(B,) are sharded over the data axis and params are replicated.
     """
 
+    n_data = mesh.shape[DATA_AXIS]
+
     def shard_body(params: Params, x: jax.Array, y: jax.Array):
+        # Shapes are static at trace time: a batch that doesn't match the
+        # baked-in global_batch would silently mis-scale the grad mean.
+        if x.shape[0] * n_data != global_batch:
+            raise ValueError(
+                f"batch {x.shape[0] * n_data} != global_batch {global_batch}"
+            )
         return _dp_update(params, x, y, dt, global_batch)
 
     sharded = jax.shard_map(
@@ -74,16 +82,20 @@ def make_dp_step(mesh: Mesh, dt: float, global_batch: int):
 
 def make_dp_eval(mesh: Mesh):
     """Sharded misclassification count: each device classifies its shard of
-    the test set, psum the error count (≙ test(), Sequential/Main.cpp:202-211)."""
+    the test set, psum the error count (≙ test(), Sequential/Main.cpp:202-211).
 
-    def shard_body(params: Params, x: jax.Array, y: jax.Array):
+    Takes a validity mask so a set padded up to an even data-axis split
+    (mesh.pad_to_multiple) never counts its pad rows as real samples.
+    """
+
+    def shard_body(params: Params, x: jax.Array, y: jax.Array, mask: jax.Array):
         pred = jax.vmap(ops.predict, in_axes=(None, 0))(params, x)
-        return jax.lax.psum(jnp.sum(pred != y), DATA_AXIS)
+        return jax.lax.psum(jnp.sum((pred != y) & mask), DATA_AXIS)
 
     sharded = jax.shard_map(
         shard_body,
         mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=P(),
     )
     return jax.jit(sharded)
@@ -97,7 +109,14 @@ def make_dp_epoch(mesh: Mesh, dt: float, global_batch: int):
     the batched counterpart of train/step.py:scan_epoch.
     """
 
+    n_data = mesh.shape[DATA_AXIS]
+
     def shard_body(params: Params, images: jax.Array, labels: jax.Array):
+        if images.shape[1] * n_data != global_batch:
+            raise ValueError(
+                f"batch {images.shape[1] * n_data} != global_batch {global_batch}"
+            )
+
         def body(p, xy):
             x, y = xy
             return _dp_update(p, x, y, dt, global_batch)

@@ -84,9 +84,7 @@ def _forward_local(params: Params, x: jax.Array):
     """
     pre_c1 = ops.conv_c1_forward(x, params["c1"]["w"], params["c1"]["b"])
     out_c1 = sigmoid(pre_c1)                       # (6/m, 24, 24) local channels
-    cm = out_c1.shape[0]
-    xw = out_c1.reshape(cm, 6, 4, 6, 4)
-    pre_s1 = jnp.einsum("mxiyj,ij->mxy", xw, params["s1"]["w"]) + params["s1"]["b"]
+    pre_s1 = ops.pool_s1_forward(out_c1, params["s1"]["w"], params["s1"]["b"])
     out_s1 = sigmoid(pre_s1)                       # (6/m, 6, 6) local channels
     # Sharded 216-contraction: local (10, 216/m) @ local (216/m,) then psum
     # — partial-product + allreduce, the corrected MPI fp_preact_f pattern.
@@ -155,7 +153,13 @@ def make_2d_step(mesh: Mesh, dt: float, global_batch: int):
     sample are decomposed over ``model`` (intra-op).
     """
 
+    n_data = mesh.shape[DATA_AXIS]
+
     def shard_body(params: Params, x: jax.Array, y: jax.Array):
+        if x.shape[0] * n_data != global_batch:
+            raise ValueError(
+                f"batch {x.shape[0] * n_data} != global_batch {global_batch}"
+            )
         errs, grads = jax.vmap(_sample_grads, in_axes=(None, 0, 0))(params, x, y)
         err_sum = lax.psum(jnp.sum(errs), DATA_AXIS)
         grad_sum = jax.tree_util.tree_map(

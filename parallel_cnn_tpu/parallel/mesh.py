@@ -51,7 +51,7 @@ def make_mesh(cfg: Optional[MeshConfig] = None, devices: Optional[Sequence] = No
                 f"but only {n} available"
             )
     dev_array = np.array(devices[: data * model]).reshape(data, model)
-    return Mesh(dev_array, cfg.axis_names)
+    return Mesh(dev_array, (DATA_AXIS, MODEL_AXIS))
 
 
 def single_device_mesh(device=None) -> Mesh:
@@ -107,7 +107,6 @@ def distributed_init(coordinator: Optional[str] = None, num_processes: Optional[
     Genuine bring-up failures (bad coordinator, barrier timeout) propagate —
     failing fast like MPI_Init, not silently degrading to single-process.
     """
-    state = getattr(jax.distributed, "global_state", None)
-    if state is not None and getattr(state, "client", None) is not None:
+    if jax.distributed.is_initialized():
         return  # already initialized — idempotent by design
     jax.distributed.initialize(coordinator, num_processes, process_id)

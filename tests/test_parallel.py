@@ -94,8 +94,21 @@ class TestDataParallel:
         ref_errs = int(step_lib.error_count(params, x, y))
         ev = data_parallel.make_dp_eval(m)
         p = mesh_lib.replicate(m, params)
-        xs, ys = mesh_lib.shard_batch(m, (x, y))
-        assert int(ev(p, xs, ys)) == ref_errs
+        mask = jnp.ones(x.shape[0], bool)
+        xs, ys, ms = mesh_lib.shard_batch(m, (x, y, mask))
+        assert int(ev(p, xs, ys, ms)) == ref_errs
+
+    def test_dp_eval_mask_excludes_padding(self, params, batch):
+        x, y = batch
+        m = mesh_lib.make_mesh()
+        ev = data_parallel.make_dp_eval(m)
+        p = mesh_lib.replicate(m, params)
+        # Corrupt the last 8 labels but mask them out: count must not change.
+        y_bad = y.at[8:].set((y[8:] + 1) % 10)
+        mask = jnp.arange(x.shape[0]) < 8
+        xs, ys, ms = mesh_lib.shard_batch(m, (x, y_bad, mask))
+        ref = int(step_lib.error_count(params, x[:8], y[:8]))
+        assert int(ev(p, xs, ys, ms)) == ref
 
     def test_dp_epoch_matches_sequential_batched_steps(self, params, batch):
         x, y = batch
