@@ -5,4 +5,4 @@ from parallel_cnn_tpu.ops.activations import (  # noqa: F401
     sigmoid,
     sigmoid_grad_from_preact,
 )
-from parallel_cnn_tpu.ops import reference  # noqa: F401
+from parallel_cnn_tpu.ops import pallas, reference  # noqa: F401

@@ -1,0 +1,502 @@
+"""Pallas TPU kernel library — the compiled-kernel path (path B).
+
+≙ the CUDA backend's 15 ``__global__`` kernels (CUDA/layer.cu:80-368,
+prototypes CUDA/layer_c.h:38-58; SURVEY.md §2.2 C17): where the reference
+hand-schedules one CUDA thread per output element, this module hand-schedules
+Mosaic kernels over a batch-block grid. It is the "native compiled kernel"
+component of the framework — Pallas lowers to Mosaic, the TPU kernel
+compiler, exactly as CUDA C++ lowers to SASS.
+
+Design (empirically validated on TPU v5e Mosaic — see probe notes):
+
+- **Batch is the grid.** The reference launches one kernel per *sample*
+  (CUDA/main.cu:178-189 inside the 60k loop). On TPU the batch dimension is
+  the only one big enough to occupy the machine, so every kernel takes a
+  ``(Bb, ...)`` batch block per grid step and the gradient kernels
+  *accumulate* partial sums across grid steps into their output block
+  (``o_ref[...] += ...`` with a first-step zero-init) — the in-VMEM
+  equivalent of the CUDA backend's ``atomicAdd`` trees
+  (CUDA/layer.cu:162,196,264) with no atomics needed: the TPU grid is
+  sequential on-core.
+- **All contractions are rank-2 ``lax.dot_general`` on the MXU**; the 5×5
+  conv is 25 unrolled tap-FMAs on the VPU (one vector op per tap, the
+  systolic analog of the CUDA output-stationary loop, CUDA/layer.cu:116-130).
+- **Layout packing lives in XLA, FLOPs live in Pallas.** This Mosaic
+  version supports neither strided slices nor lane-splitting reshapes
+  in-kernel, so the stride-4 window gather for the pool layer and the
+  im2col patch matrices are built host-side (they are free or cheap
+  relayouts XLA already excels at) and the kernels see dense rank-2/3
+  blocks. Scalar stores to VMEM are also rejected — every kernel output is
+  a vector row or tile; the few true-scalar reductions (bias grads, error
+  norm) stay in XLA glue.
+
+Numerics contract is identical to ops/reference.py (SURVEY.md §2.1): same
+/576 and /216 grad normalizations, same (onehot − output) error vector.
+Differential tests: tests/test_ops_pallas.py diffs this path against the
+jnp path A on an 8-device CPU harness in interpret mode.
+
+Flat layout convention: the 6×6×6 pool/FC boundary is flattened
+channel-major, lane = m*36 + x*6 + y — the same C-order flatten the
+reference uses for l_s1.output → fp_preact_f (Sequential/layer.h:184-198).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from parallel_cnn_tpu.ops import reference as ref_ops
+from parallel_cnn_tpu.ops.activations import error_norm, make_error
+
+Params = ref_ops.Params
+
+
+def _interpret() -> bool:
+    """Compiled Mosaic on TPU; interpreter everywhere else (CPU tests)."""
+    return jax.default_backend() != "tpu"
+
+
+def _batch_block(n: int, want: int = 128) -> int:
+    """Largest divisor of n that is ≤ want (grid must tile the batch)."""
+    b = min(n, want)
+    while n % b:
+        b -= 1
+    return b
+
+
+# VMEM budget: rank-4 (Bb,6,24,24) blocks pad their lane dim 24→128, so a
+# conv-layer block costs 6·24·128·4 B ≈ 74 KB/sample and Pallas double-buffers
+# every pipelined block — 32 samples keeps conv kernels ≈ 10 MB < 16 MB VMEM.
+# Flat (Bb,216) blocks are ~1 KB/sample and can run much wider.
+CONV_BLOCK = 32
+FLAT_BLOCK = 256
+
+
+def _sigmoid(v):
+    # jax.nn.sigmoid — the numerically stable two-branch form, same as
+    # activations.sigmoid (path A); lowers cleanly in Mosaic.
+    return jax.nn.sigmoid(v)
+
+
+def _pad_batch(n: int, block: int) -> int:
+    """Samples of zero-padding needed to reach a multiple of `block`.
+
+    Without padding, awkward batch sizes (primes, dataset remainders) would
+    fall back to divisor-of-n blocks as small as 1 — a silent 100× grid
+    blow-up. Public entry points pad instead and mask/slice the pad away.
+    """
+    return (-n) % block
+
+
+# ---------------------------------------------------------------------------
+# Forward kernels
+# ---------------------------------------------------------------------------
+
+
+def _conv_fwd_kernel(x_ref, w_ref, b_ref, pre_ref, out_ref):
+    """≙ fp_c1 (CUDA/layer.cu:116-130) + apply_step_function (:85-95), fused.
+
+    One grid step = one batch block. 6 filters × 25 taps unrolled: each tap
+    is a (Bb, 24, 24) VPU FMA against a shifted window of the input block —
+    output-stationary like the CUDA kernel, but vectorized over the batch
+    instead of threaded over output pixels.
+    """
+    for m in range(6):
+        acc = jnp.full(pre_ref.shape[:1] + (24, 24), b_ref[m, 0], pre_ref.dtype)
+        for i in range(5):
+            for j in range(5):
+                acc = acc + w_ref[m, i, j] * x_ref[:, i : i + 24, j : j + 24]
+        pre_ref[:, m] = acc
+        out_ref[:, m] = _sigmoid(acc)
+
+
+def conv_fwd(x: jax.Array, w: jax.Array, b: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(B,28,28)·(6,5,5)+(6,) → (pre_c1, out_c1), both (B,6,24,24)."""
+    n = x.shape[0]
+    bb = _batch_block(n, CONV_BLOCK)
+    return pl.pallas_call(
+        _conv_fwd_kernel,
+        grid=(n // bb,),
+        in_specs=[
+            pl.BlockSpec((bb, 28, 28), lambda g: (g, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((6, 5, 5), lambda g: (0, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((6, 1), lambda g: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((bb, 6, 24, 24), lambda g: (g, 0, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, 6, 24, 24), lambda g: (g, 0, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, 6, 24, 24), x.dtype),
+            jax.ShapeDtypeStruct((n, 6, 24, 24), x.dtype),
+        ],
+        interpret=_interpret(),
+    )(x, w, b.reshape(6, 1))
+
+
+def pack_pool_windows(out_c1: jax.Array) -> jax.Array:
+    """(B,6,24,24) → (B,16,216): stride-4 4×4 windows, tap-major sublane,
+    flat channel-major window lane (t = 4i+j, lane = m*36 + x*6 + y).
+
+    Host-side XLA relayout — the stride-4 gather Mosaic can't express
+    in-kernel; 24 = 6·4 tiles exactly so it is a pure reshape+transpose.
+    """
+    b = out_c1.shape[0]
+    win = out_c1.reshape(b, 6, 6, 4, 6, 4)          # (b, m, x, i, y, j)
+    return win.transpose(0, 3, 5, 1, 2, 4).reshape(b, 16, 216)
+
+
+def unpack_pool_windows(d_xw: jax.Array) -> jax.Array:
+    """Inverse of pack_pool_windows: (B,16,216) → (B,6,24,24)."""
+    b = d_xw.shape[0]
+    win = d_xw.reshape(b, 4, 4, 6, 6, 6)            # (b, i, j, m, x, y)
+    return win.transpose(0, 3, 4, 1, 5, 2).reshape(b, 6, 24, 24)
+
+
+def _pool_fwd_kernel(xw_ref, w_ref, b_ref, pre_ref, out_ref):
+    """≙ fp_s1 (CUDA/layer.cu:132-149) + sigmoid, fused.
+
+    16 tap-FMAs over the packed (Bb, 16, 216) window block: tap t rides the
+    sublane-adjacent dim, the 216 pool outputs ride the lane dim.
+    """
+    acc = jnp.full(pre_ref.shape, b_ref[0, 0], pre_ref.dtype)
+    for t in range(16):
+        acc = acc + w_ref[t, 0] * xw_ref[:, t, :]
+    pre_ref[:] = acc
+    out_ref[:] = _sigmoid(acc)
+
+
+def pool_fwd(xw: jax.Array, w: jax.Array, b: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(B,16,216)·(4,4)+() → (pre_s1, out_s1), both (B,216) flat channel-major."""
+    n = xw.shape[0]
+    bb = _batch_block(n, FLAT_BLOCK)
+    return pl.pallas_call(
+        _pool_fwd_kernel,
+        grid=(n // bb,),
+        in_specs=[
+            pl.BlockSpec((bb, 16, 216), lambda g: (g, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((16, 1), lambda g: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda g: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((bb, 216), lambda g: (g, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, 216), lambda g: (g, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, 216), xw.dtype),
+            jax.ShapeDtypeStruct((n, 216), xw.dtype),
+        ],
+        interpret=_interpret(),
+    )(xw, w.reshape(16, 1), b.reshape(1, 1))
+
+
+def _fc_fwd_kernel(x_ref, w_ref, b_ref, pre_ref, out_ref):
+    """≙ fp_f (CUDA/layer.cu:151-165, minus bug B10's redundant launch):
+    one MXU contraction (Bb,216)·(10,216)ᵀ per block + bias row."""
+    acc = lax.dot_general(
+        x_ref[:], w_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=pre_ref.dtype,
+        precision=lax.Precision.HIGHEST,
+    ) + b_ref[:]
+    pre_ref[:] = acc
+    out_ref[:] = _sigmoid(acc)
+
+
+def fc_fwd(x: jax.Array, w: jax.Array, b: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(B,216)·(10,216)+(10,) → (pre_f, out_f), both (B,10)."""
+    n = x.shape[0]
+    bb = _batch_block(n, FLAT_BLOCK)
+    return pl.pallas_call(
+        _fc_fwd_kernel,
+        grid=(n // bb,),
+        in_specs=[
+            pl.BlockSpec((bb, 216), lambda g: (g, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((10, 216), lambda g: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 10), lambda g: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((bb, 10), lambda g: (g, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, 10), lambda g: (g, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, 10), x.dtype),
+            jax.ShapeDtypeStruct((n, 10), x.dtype),
+        ],
+        interpret=_interpret(),
+    )(x, w, b.reshape(1, 10))
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _fc_bwd_kernel(d_ref, s_ref, w_ref, gw_ref, gb_ref, dout_ref):
+    """≙ bp_weight_f + bp_bias_f + bp_output_s1 (CUDA/layer.cu:167-216), fused.
+
+    Weight grad: (10,Bb)·(Bb,216) MXU outer-product partial, accumulated
+    across the batch grid (≙ the CUDA atomicAdd, layer.cu:196). Also emits
+    d_out_s1 = d_pre_f · W for the next stage in the same pass.
+    """
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        gw_ref[:] = jnp.zeros_like(gw_ref)
+        gb_ref[:] = jnp.zeros_like(gb_ref)
+
+    d = d_ref[:]
+    gw_ref[:] += lax.dot_general(
+        d, s_ref[:], (((0,), (0,)), ((), ())), preferred_element_type=gw_ref.dtype,
+        precision=lax.Precision.HIGHEST,
+    )
+    gb_ref[:] += jnp.sum(d, axis=0, keepdims=True)
+    dout_ref[:] = lax.dot_general(
+        d, w_ref[:], (((1,), (0,)), ((), ())), preferred_element_type=dout_ref.dtype,
+        precision=lax.Precision.HIGHEST,
+    )
+
+
+def fc_bwd(
+    d_pre_f: jax.Array, out_s1: jax.Array, w: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(B,10),(B,216),(10,216) → (g_w_f (10,216) summed over batch,
+    g_b_f (10,) summed, d_out_s1 (B,216))."""
+    n = d_pre_f.shape[0]
+    bb = _batch_block(n, FLAT_BLOCK)
+    gw, gb, dout = pl.pallas_call(
+        _fc_bwd_kernel,
+        grid=(n // bb,),
+        in_specs=[
+            pl.BlockSpec((bb, 10), lambda g: (g, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, 216), lambda g: (g, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((10, 216), lambda g: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((10, 216), lambda g: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 10), lambda g: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, 216), lambda g: (g, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((10, 216), d_pre_f.dtype),
+            jax.ShapeDtypeStruct((1, 10), d_pre_f.dtype),
+            jax.ShapeDtypeStruct((n, 216), d_pre_f.dtype),
+        ],
+        interpret=_interpret(),
+    )(d_pre_f, out_s1, w)
+    return gw, gb.reshape(10), dout
+
+
+def _pool_bwd_kernel(dout_ref, pre_ref, w_ref, dpre_ref, dxw_ref):
+    """≙ bp_preact_s1 + bp_output_c1 (CUDA/layer.cu:230-254), fused:
+    σ′ chain through the pool preact, then scatter through the shared 4×4
+    kernel into window layout (the strided scatter the CUDA kernel does
+    one-thread-per-element; here one VPU row per tap)."""
+    s = _sigmoid(pre_ref[:])
+    dpre = dout_ref[:] * s * (1.0 - s)
+    dpre_ref[:] = dpre
+    for t in range(16):
+        dxw_ref[:, t, :] = w_ref[t, 0] * dpre
+
+
+def pool_bwd(
+    d_out_s1: jax.Array, pre_s1: jax.Array, w: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """(B,216),(B,216),(4,4) → (d_pre_s1 (B,216), d_xw (B,16,216))."""
+    n = d_out_s1.shape[0]
+    bb = _batch_block(n, FLAT_BLOCK)
+    return pl.pallas_call(
+        _pool_bwd_kernel,
+        grid=(n // bb,),
+        in_specs=[
+            pl.BlockSpec((bb, 216), lambda g: (g, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, 216), lambda g: (g, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((16, 1), lambda g: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((bb, 216), lambda g: (g, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, 16, 216), lambda g: (g, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, 216), d_out_s1.dtype),
+            jax.ShapeDtypeStruct((n, 16, 216), d_out_s1.dtype),
+        ],
+        interpret=_interpret(),
+    )(d_out_s1, pre_s1, w.reshape(16, 1))
+
+
+def _accum_matmul_kernel(a_ref, b_ref, o_ref):
+    """Grid-accumulated Aᵀ·B: the generic weight-grad contraction
+    (≙ the CUDA backward weight kernels' atomicAdd reductions)."""
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    o_ref[:] += lax.dot_general(
+        a_ref[:], b_ref[:], (((0,), (0,)), ((), ())), preferred_element_type=o_ref.dtype,
+        precision=lax.Precision.HIGHEST,
+    )
+
+
+def _accum_matmul(a: jax.Array, b: jax.Array, row_block: int) -> jax.Array:
+    """(N,ka),(N,kb) → (ka,kb) = Σ_n a[n,:]ᵀ b[n,:], grid over row chunks."""
+    n = a.shape[0]
+    rb = _batch_block(n, row_block)
+    ka, kb = a.shape[1], b.shape[1]
+    return pl.pallas_call(
+        _accum_matmul_kernel,
+        grid=(n // rb,),
+        in_specs=[
+            pl.BlockSpec((rb, ka), lambda g: (g, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((rb, kb), lambda g: (g, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((ka, kb), lambda g: (0, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((ka, kb), a.dtype),
+        interpret=_interpret(),
+    )(a, b)
+
+
+def pool_wgrad(out_c1_windows: jax.Array, d_pre_s1: jax.Array) -> jax.Array:
+    """≙ bp_weight_s1 (CUDA/layer.cu:218-228): g_w_s1[i,j] = Σ_{b,w}
+    d_pre_s1[b,w] · windows[b,4i+j,w], as one (B·216,16)ᵀ·(B·216,1) MXU
+    contraction accumulated over row chunks."""
+    b = out_c1_windows.shape[0]
+    xw2 = out_c1_windows.transpose(0, 2, 1).reshape(b * 216, 16)
+    dp2 = d_pre_s1.reshape(b * 216, 1)
+    g = _accum_matmul(xw2, dp2, row_block=216 * 8)
+    return g.reshape(4, 4)
+
+
+def _sigma_prime_kernel(dout_ref, pre_ref, o_ref):
+    """≙ bp_preact_c1 (CUDA/layer.cu:292-305): d_pre = d_out · σ′(pre)."""
+    s = _sigmoid(pre_ref[:])
+    o_ref[:] = dout_ref[:] * s * (1.0 - s)
+
+
+def conv_bwd_dpre(d_out_c1: jax.Array, pre_c1: jax.Array) -> jax.Array:
+    """(B,6,24,24) σ′ chain, elementwise on the VPU."""
+    n = d_out_c1.shape[0]
+    bb = _batch_block(n, CONV_BLOCK)
+    return pl.pallas_call(
+        _sigma_prime_kernel,
+        grid=(n // bb,),
+        in_specs=[
+            pl.BlockSpec((bb, 6, 24, 24), lambda g: (g, 0, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, 6, 24, 24), lambda g: (g, 0, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bb, 6, 24, 24), lambda g: (g, 0, 0, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(d_out_c1.shape, d_out_c1.dtype),
+        interpret=_interpret(),
+    )(d_out_c1, pre_c1)
+
+
+def conv_wgrad(x: jax.Array, d_pre_c1: jax.Array) -> jax.Array:
+    """≙ bp_weight_c1 (CUDA/layer.cu:307-335): /576-normalized correlation
+    of d_pre_c1 with the input patches, as a (B·576,6)ᵀ·(B·576,25) MXU
+    contraction. im2col (patch matrix) is host-side XLA."""
+    b = x.shape[0]
+    # (B, 25, 24, 24): feature dim = 5i+j tap order (1 input channel)
+    patches = lax.conv_general_dilated_patches(x[:, None], (5, 5), (1, 1), "VALID")
+    pm = patches.transpose(0, 2, 3, 1).reshape(b * 576, 25)
+    dpm = d_pre_c1.transpose(0, 2, 3, 1).reshape(b * 576, 6)
+    g = _accum_matmul(dpm, pm, row_block=576 * 8)  # (6, 25)
+    return g.reshape(6, 5, 5) / ref_ops.CONV_NORM
+
+
+# ---------------------------------------------------------------------------
+# Full batched forward / backward on the Pallas path
+# ---------------------------------------------------------------------------
+
+
+def _forward_flat(params: Params, xs: jax.Array):
+    """The shared three-stage Pallas forward pipeline (flat pool/FC layout).
+
+    Returns (pre_c1, out_c1, xw, pre_s1, out_s1, pre_f, out_f) with the
+    pool/FC stages in (B,216) flat channel-major layout. The batch must
+    already be a multiple of CONV_BLOCK (public entry points pad)."""
+    pre_c1, out_c1 = conv_fwd(xs, params["c1"]["w"], params["c1"]["b"])
+    xw = pack_pool_windows(out_c1)
+    pre_s1, out_s1 = pool_fwd(xw, params["s1"]["w"], params["s1"]["b"])
+    pre_f, out_f = fc_fwd(out_s1, params["f"]["w"], params["f"]["b"])
+    return pre_c1, out_c1, xw, pre_s1, out_s1, pre_f, out_f
+
+
+def forward(params: Params, xs: jax.Array):
+    """Batched forward through the three Pallas stages.
+
+    Returns the same Activations tuple as ops/reference.py:forward (batched,
+    pool/FC stages in flat channel-major layout reshaped back to (6,6,6))."""
+    n = xs.shape[0]
+    pad = _pad_batch(n, CONV_BLOCK)
+    if pad:
+        xs = jnp.concatenate([xs, jnp.zeros((pad,) + xs.shape[1:], xs.dtype)])
+    pre_c1, out_c1, _, pre_s1, out_s1, pre_f, out_f = _forward_flat(params, xs)
+    np_ = n + pad
+    acts = ref_ops.Activations(
+        xs,
+        pre_c1,
+        out_c1,
+        pre_s1.reshape(np_, 6, 6, 6),
+        out_s1.reshape(np_, 6, 6, 6),
+        pre_f,
+        out_f,
+    )
+    if pad:
+        acts = ref_ops.Activations(*(a[:n] for a in acts))
+    return acts
+
+
+def predict(params: Params, xs: jax.Array) -> jax.Array:
+    """≙ classify (CUDA/main.cu:200-223): batched argmax over the outputs."""
+    return jnp.argmax(forward(params, xs).out_f, axis=-1)
+
+
+def batched_value_and_ref_grads(
+    params: Params, xs: jax.Array, ys: jax.Array
+) -> Tuple[jax.Array, Params]:
+    """(err_mean, batch-mean reference grads) on the Pallas path.
+
+    Matches jax.vmap(ops.reference.value_and_ref_grads) + tree-mean to fp
+    tolerance; same reference contract (SURVEY.md §2.1), kernels instead of
+    XLA ops for every FLOP-bearing stage. Batches that don't tile
+    CONV_BLOCK are zero-padded; padded rows are masked out of the error
+    vector, so every grad contribution below is exactly zero for them.
+    """
+    n = xs.shape[0]
+    pad = _pad_batch(n, CONV_BLOCK)
+    if pad:
+        xs = jnp.concatenate([xs, jnp.zeros((pad,) + xs.shape[1:], xs.dtype)])
+        ys = jnp.concatenate([ys, jnp.zeros((pad,), ys.dtype)])
+
+    pre_c1, out_c1, xw, pre_s1, out_s1, pre_f, out_f = _forward_flat(params, xs)
+
+    # makeError + vectorNorm (host glue: O(B·10))
+    d_pre_f = jax.vmap(make_error)(out_f, ys)
+    if pad:
+        mask = (jnp.arange(n + pad) < n).astype(d_pre_f.dtype)
+        d_pre_f = d_pre_f * mask[:, None]
+    err_mean = jnp.sum(jax.vmap(error_norm)(d_pre_f)) / n
+
+    g_w_f, g_b_f, d_out_s1 = fc_bwd(d_pre_f, out_s1, params["f"]["w"])
+    d_pre_s1, d_xw = pool_bwd(d_out_s1, pre_s1, params["s1"]["w"])
+    g_w_s1 = pool_wgrad(xw, d_pre_s1)
+    # bp_bias_s1 (CUDA/layer.cu:256-266, minus bug B9): mean over all 216
+    g_b_s1 = jnp.sum(d_pre_s1) / ref_ops.POOL_BIAS_NORM
+
+    d_out_c1 = unpack_pool_windows(d_xw)
+    d_pre_c1 = conv_bwd_dpre(d_out_c1, pre_c1)
+    g_w_c1 = conv_wgrad(xs, d_pre_c1)
+    # bp_bias_c1 (CUDA/layer.cu:337-368): /576-normalized per-filter mean
+    g_b_c1 = jnp.sum(d_pre_c1, axis=(0, 2, 3)) / ref_ops.CONV_NORM
+
+    inv_n = 1.0 / n
+    grads: Params = {
+        "c1": {"w": g_w_c1 * inv_n, "b": g_b_c1 * inv_n},
+        "s1": {"w": g_w_s1 * inv_n, "b": g_b_s1 * inv_n},
+        "f": {"w": g_w_f * inv_n, "b": g_b_f * inv_n},
+    }
+    return err_mean, grads
