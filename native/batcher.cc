@@ -1,0 +1,166 @@
+// Native prefetching batch pipeline — the framework's data-runtime
+// component, in C++ like the reference's runtime layer.
+//
+// The reference has no batching at all: its training loop walks the dataset
+// one sample per step in file order (Sequential/Main.cpp:154-171), and the
+// CUDA backend pays a host→device copy per sample (CUDA/layer.cu:60-63,
+// SURVEY.md §3.2). Here a worker thread assembles shuffled batches into a
+// ring of reusable slots *while the TPU trains on the previous batch*, so
+// host-side gather/shuffle time overlaps device compute and the Python side
+// always finds the next contiguous batch ready for one jax.device_put.
+//
+// Zero-copy handoff: acquire() returns pointers into the ring slot; the
+// consumer calls release() when the batch has been devic-put. Epoch
+// shuffling is Fisher–Yates under a seeded xorshift64* (deterministic
+// given the seed — the framework's reproducibility contract; the reference
+// replays file order, which is the shuffle=false mode).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr long kImageSize = 28 * 28;
+
+// xorshift64* — tiny, seedable, good enough for epoch permutations.
+struct XorShift64 {
+  uint64_t s;
+  uint64_t next() {
+    s ^= s >> 12;
+    s ^= s << 25;
+    s ^= s >> 27;
+    return s * 0x2545F4914F6CDD1DULL;
+  }
+};
+
+struct Slot {
+  std::vector<float> x;
+  std::vector<int32_t> y;
+  bool ready = false;
+};
+
+struct Batcher {
+  const float* images;   // borrowed; caller keeps alive (numpy array)
+  const int32_t* labels; // borrowed
+  long n;
+  long batch;
+  bool shuffle;
+  XorShift64 rng;
+
+  std::vector<Slot> ring;
+  size_t head = 0;  // next slot the producer fills
+  size_t tail = 0;  // next slot the consumer takes
+  std::mutex mu;
+  std::condition_variable cv_producer, cv_consumer;
+  std::atomic<bool> stop{false};
+  std::thread worker;
+
+  std::vector<long> perm;
+  long cursor = 0;  // position in perm; wraps per epoch
+
+  void reshuffle() {
+    for (long i = n - 1; i > 0; --i) {
+      long j = long(rng.next() % uint64_t(i + 1));
+      std::swap(perm[i], perm[j]);
+    }
+  }
+
+  void fill(Slot* slot) {
+    for (long b = 0; b < batch; ++b) {
+      if (cursor == n) {
+        cursor = 0;
+        if (shuffle) reshuffle();
+      }
+      const long src = perm[cursor++];
+      std::memcpy(slot->x.data() + b * kImageSize, images + src * kImageSize,
+                  sizeof(float) * kImageSize);
+      slot->y[size_t(b)] = labels[src];
+    }
+  }
+
+  void run() {
+    for (;;) {
+      std::unique_lock<std::mutex> lock(mu);
+      cv_producer.wait(lock,
+                       [&] { return stop.load() || !ring[head].ready; });
+      if (stop.load()) return;
+      Slot* slot = &ring[head];
+      lock.unlock();
+      fill(slot);  // heavy copy outside the lock; slot is producer-owned
+      lock.lock();
+      slot->ready = true;
+      head = (head + 1) % ring.size();
+      cv_consumer.notify_one();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// images: (n, 28, 28) float32, labels: (n,) int32 — borrowed for the
+// batcher's lifetime. depth = ring slots (≥2 for overlap).
+void* pcnn_batcher_create(const float* images, const int32_t* labels, long n,
+                          long batch, long depth, uint64_t seed,
+                          int shuffle) {
+  if (n <= 0 || batch <= 0 || depth < 1) return nullptr;
+  auto* b = new Batcher();
+  b->images = images;
+  b->labels = labels;
+  b->n = n;
+  b->batch = batch;
+  b->shuffle = shuffle != 0;
+  b->rng.s = seed ? seed : 0x9E3779B97F4A7C15ULL;
+  b->ring.resize(size_t(depth));
+  for (auto& slot : b->ring) {
+    slot.x.resize(size_t(batch) * kImageSize);
+    slot.y.resize(size_t(batch));
+  }
+  b->perm.resize(size_t(n));
+  for (long i = 0; i < n; ++i) b->perm[size_t(i)] = i;
+  if (b->shuffle) b->reshuffle();
+  b->worker = std::thread([b] { b->run(); });
+  return b;
+}
+
+// Blocks until the next batch is ready; hands out slot pointers (valid
+// until the matching release). Returns 0, or -1 after destroy.
+long pcnn_batcher_acquire(void* handle, float** out_x, int32_t** out_y) {
+  auto* b = static_cast<Batcher*>(handle);
+  std::unique_lock<std::mutex> lock(b->mu);
+  b->cv_consumer.wait(lock,
+                      [&] { return b->stop.load() || b->ring[b->tail].ready; });
+  if (b->stop.load()) return -1;
+  Slot& slot = b->ring[b->tail];
+  *out_x = slot.x.data();
+  *out_y = slot.y.data();
+  return 0;
+}
+
+// Marks the current batch consumed; its pointers become invalid.
+void pcnn_batcher_release(void* handle) {
+  auto* b = static_cast<Batcher*>(handle);
+  {
+    std::lock_guard<std::mutex> lock(b->mu);
+    b->ring[b->tail].ready = false;
+    b->tail = (b->tail + 1) % b->ring.size();
+  }
+  b->cv_producer.notify_one();
+}
+
+void pcnn_batcher_destroy(void* handle) {
+  auto* b = static_cast<Batcher*>(handle);
+  b->stop.store(true);
+  b->cv_producer.notify_one();
+  b->cv_consumer.notify_one();
+  if (b->worker.joinable()) b->worker.join();
+  delete b;
+}
+
+}  // extern "C"

@@ -1,0 +1,104 @@
+// Native idx-ubyte MNIST loader (≙ the reference's C loader,
+// Sequential/mnist.h:79-160, byte-identical across its four backends).
+//
+// Same format + error-code contract as mnist_load():
+//   magic 2051 (images) / 2049 (labels), big-endian u32 header fields
+//   (mnist.h:60-71,100-110), 28x28 validation (:128-131), /255.0 pixel
+//   scaling (:143-146); 0 on success, negative codes on failure
+//   (-1 missing file, -2 bad image file, -3 bad label file, -4 count
+//   mismatch — mnist.h:96-121).
+//
+// Unlike the reference (per-sample fread into one struct per image), this
+// reads each file with one bulk fread and vectorizes the u8→f32 scale, then
+// hands Python a caller-allocated contiguous buffer ready for
+// jax.device_put. Two-phase API (count query, then fill) so the Python side
+// owns all allocation — no ownership crossing the FFI boundary.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kImageMagic = 2051;
+constexpr uint32_t kLabelMagic = 2049;
+
+// ≙ mnist_bin_to_int (Sequential/mnist.h:60-71): big-endian u32.
+bool read_u32be(FILE* f, uint32_t* out) {
+  unsigned char b[4];
+  if (fread(b, 1, 4, f) != 4) return false;
+  *out = (uint32_t(b[0]) << 24) | (uint32_t(b[1]) << 16) |
+         (uint32_t(b[2]) << 8) | uint32_t(b[3]);
+  return true;
+}
+
+struct FileCloser {
+  FILE* f;
+  ~FileCloser() {
+    if (f) fclose(f);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Returns the image count, or a negative error code.
+long pcnn_mnist_image_count(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  FileCloser closer{f};
+  uint32_t magic, count, rows, cols;
+  if (!read_u32be(f, &magic) || magic != kImageMagic) return -2;
+  if (!read_u32be(f, &count) || !read_u32be(f, &rows) || !read_u32be(f, &cols))
+    return -2;
+  if (rows != 28 || cols != 28) return -2;
+  return long(count);
+}
+
+// Fills `out` (n*28*28 floats, scaled /255) from the image file.
+// n must equal pcnn_mnist_image_count(path). Returns 0 or negative code.
+long pcnn_mnist_load_images(const char* path, float* out, long n) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  FileCloser closer{f};
+  uint32_t magic, count, rows, cols;
+  if (!read_u32be(f, &magic) || magic != kImageMagic) return -2;
+  if (!read_u32be(f, &count) || !read_u32be(f, &rows) || !read_u32be(f, &cols))
+    return -2;
+  if (rows != 28 || cols != 28 || long(count) != n) return -2;
+  const size_t total = size_t(n) * 28 * 28;
+  std::vector<unsigned char> raw(total);
+  if (fread(raw.data(), 1, total, f) != total) return -2;
+  // True division (not reciprocal-multiply): bit-identical to both the
+  // reference's /255.0 (mnist.h:143-146) and the NumPy parser.
+  for (size_t i = 0; i < total; ++i) out[i] = float(raw[i]) / 255.0f;
+  return 0;
+}
+
+long pcnn_mnist_label_count(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  FileCloser closer{f};
+  uint32_t magic, count;
+  if (!read_u32be(f, &magic) || magic != kLabelMagic) return -3;
+  if (!read_u32be(f, &count)) return -3;
+  return long(count);
+}
+
+// Fills `out` (n int32 labels). Returns 0 or negative code.
+long pcnn_mnist_load_labels(const char* path, int32_t* out, long n) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  FileCloser closer{f};
+  uint32_t magic, count;
+  if (!read_u32be(f, &magic) || magic != kLabelMagic) return -3;
+  if (!read_u32be(f, &count) || long(count) != n) return -3;
+  std::vector<unsigned char> raw(static_cast<size_t>(n));
+  if (fread(raw.data(), 1, size_t(n), f) != size_t(n)) return -3;
+  for (long i = 0; i < n; ++i) out[i] = int32_t(raw[i]);
+  return 0;
+}
+
+}  // extern "C"

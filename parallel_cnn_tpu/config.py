@@ -53,6 +53,17 @@ class TrainConfig:
     # dtype for the compute path. The reference is float32 throughout;
     # bfloat16 is the MXU-native option for throughput runs.
     dtype: str = "float32"
+    # Epoch shuffling. The reference replays file order every epoch
+    # (Sequential/Main.cpp:157), so parity default is False.
+    shuffle: bool = False
+    # Host-side batch assembly for batch_size > 1:
+    #   "auto"   — use the native C++ prefetching batcher (data/native.py)
+    #              when the extension builds, else plain NumPy slicing;
+    #   "native" — require the native batcher (error if unavailable);
+    #   "off"    — always plain NumPy slicing.
+    # The native path drops the ragged tail batch (fixed-shape steps);
+    # the NumPy path runs the tail at its own shape.
+    prefetch: str = "auto"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -33,6 +33,19 @@ class TrainResult:
     stopped_early: bool = False
 
 
+def _native_batcher_cls(tc):
+    """The native Batcher class when configured and buildable, else None."""
+    if tc.batch_size <= 1 or tc.prefetch == "off":
+        return None
+    try:
+        from parallel_cnn_tpu.data import native
+    except ImportError:
+        if tc.prefetch == "native":
+            raise
+        return None
+    return native.Batcher
+
+
 def learn(
     cfg: Config,
     train: pipeline.Dataset,
@@ -61,16 +74,55 @@ def learn(
         images = jnp.asarray(train.images)
         labels = jnp.asarray(train.labels)
 
-    for _ in range(tc.epochs):
+    batcher_cls = _native_batcher_cls(tc)
+    steps_per_epoch = len(train) // tc.batch_size if tc.batch_size > 1 else 0
+
+    for epoch in range(tc.epochs):
+        # Per-epoch derived seed: every path reshuffles each epoch (and all
+        # paths draw the same epoch boundary semantics — an epoch is one
+        # pass from index 0, shuffled or in file order).
+        epoch_seed = tc.seed + epoch
         with sw:
             if tc.batch_size == 1:
-                params, err = step_lib.scan_epoch(params, images, labels, tc.dt)
+                if tc.shuffle:
+                    perm = jnp.asarray(
+                        np.random.default_rng(epoch_seed).permutation(
+                            len(train)
+                        )
+                    )
+                    ex, ey = images[perm], labels[perm]
+                else:
+                    ex, ey = images, labels
+                params, err = step_lib.scan_epoch(params, ex, ey, tc.dt)
+            elif batcher_cls is not None and steps_per_epoch > 0:
+                # Native C++ prefetch ring: batch assembly overlaps the
+                # device step; fixed shapes, tail dropped, cursor reset at
+                # the epoch boundary (fresh Batcher per epoch).
+                errs = []
+                with batcher_cls(
+                    train.images,
+                    train.labels,
+                    tc.batch_size,
+                    seed=epoch_seed,
+                    shuffle=tc.shuffle,
+                ) as batcher:
+                    for _ in range(steps_per_epoch):
+                        bx, by = next(batcher)
+                        params, e = step_lib.batched_step(
+                            params, jnp.asarray(bx), jnp.asarray(by), tc.dt
+                        )
+                        errs.append(e)
+                err = jnp.mean(jnp.stack(errs))
             else:
                 errs, weights = [], []
                 # drop_remainder=False: the tail batch runs at its own
                 # (smaller) shape — one extra XLA compile, no dropped data.
                 for bx, by in pipeline.epoch_batches(
-                    train, tc.batch_size, drop_remainder=False
+                    train,
+                    tc.batch_size,
+                    shuffle=tc.shuffle,
+                    seed=epoch_seed,
+                    drop_remainder=False,
                 ):
                     params, e = step_lib.batched_step(
                         params, jnp.asarray(bx), jnp.asarray(by), tc.dt
