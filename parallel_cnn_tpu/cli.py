@@ -1,0 +1,166 @@
+"""CLI driver (≙ main(), Sequential/Main.cpp:44-57 — which accepts
+argc/argv and ignores them; here the flags actually work).
+
+    python -m parallel_cnn_tpu [--loader …] [--epochs N] [--batch-size B] …
+
+Drives the same flow as every reference backend: load data → learn →
+test, printing the reference's lines ("Learning", per-epoch error, final
+error rate), plus the subsystems the reference lacks: checkpoint/resume,
+structured metrics, and the per-phase profile table (paper Tables 4-8).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+from typing import List, Optional
+
+from parallel_cnn_tpu.config import Config, DataConfig, TrainConfig
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="parallel_cnn_tpu",
+        description="TPU-native trainer with the reference's capabilities",
+    )
+    d, t = DataConfig(), TrainConfig()
+    p.add_argument("--loader", default=d.loader,
+                   choices=["auto", "native", "numpy", "synthetic"])
+    p.add_argument("--data-dir", default=None,
+                   help="directory holding the four idx files "
+                        "(defaults to the DataConfig paths)")
+    p.add_argument("--epochs", type=int, default=t.epochs)
+    p.add_argument("--batch-size", type=int, default=t.batch_size)
+    p.add_argument("--dt", type=float, default=t.dt,
+                   help="SGD step (dt at Sequential/layer.h:12)")
+    p.add_argument("--threshold", type=float, default=t.threshold,
+                   help="early-stop err threshold (layer.h:13)")
+    p.add_argument("--seed", type=int, default=t.seed)
+    p.add_argument("--shuffle", action="store_true")
+    p.add_argument("--prefetch", default=t.prefetch,
+                   choices=["auto", "native", "off"])
+    p.add_argument("--synthetic-train-count", type=int,
+                   default=d.synthetic_train_count)
+    p.add_argument("--synthetic-test-count", type=int,
+                   default=d.synthetic_test_count)
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="save ckpt_<epoch>.npz per epoch; --resume restarts "
+                        "from the latest")
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--metrics", default=None, metavar="PATH",
+                   help="append JSONL metrics records to PATH")
+    p.add_argument("--profile", action="store_true",
+                   help="print the per-phase table (paper Tables 4-8 shape)")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> Config:
+    data = DataConfig(
+        loader=args.loader,
+        synthetic_train_count=args.synthetic_train_count,
+        synthetic_test_count=args.synthetic_test_count,
+    )
+    if args.data_dir:
+        data = DataConfig(
+            train_images=os.path.join(args.data_dir, "train-images.idx3-ubyte"),
+            train_labels=os.path.join(args.data_dir, "train-labels.idx1-ubyte"),
+            test_images=os.path.join(args.data_dir, "t10k-images.idx3-ubyte"),
+            test_labels=os.path.join(args.data_dir, "t10k-labels.idx1-ubyte"),
+            loader=args.loader,
+            synthetic_train_count=args.synthetic_train_count,
+            synthetic_test_count=args.synthetic_test_count,
+        )
+    train = TrainConfig(
+        dt=args.dt,
+        threshold=args.threshold,
+        epochs=args.epochs,
+        batch_size=args.batch_size,
+        seed=args.seed,
+        shuffle=args.shuffle,
+        prefetch=args.prefetch,
+    )
+    return Config(data=data, train=train)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = config_from_args(args)
+
+    import jax
+    import jax.numpy as jnp
+
+    from parallel_cnn_tpu.data import pipeline
+    from parallel_cnn_tpu.models import lenet_ref
+    from parallel_cnn_tpu.parallel import distributed
+    from parallel_cnn_tpu.train import checkpoint, trainer
+    from parallel_cnn_tpu.utils.metrics import MetricsLogger, throughput
+    from parallel_cnn_tpu.utils import profiling
+
+    distributed.initialize()  # env-configured multi-host; no-op otherwise
+    train_ds, test_ds = pipeline.load_train_test(cfg.data)
+
+    params = None
+    start_epoch = 0
+    error_history: List[float] = []
+    if args.checkpoint_dir and args.resume:
+        path = checkpoint.latest(args.checkpoint_dir)
+        if path:
+            like = lenet_ref.init(jax.random.key(cfg.train.seed))
+            params, state = checkpoint.restore(path, like)
+            start_epoch = state.epoch
+            error_history = list(state.epoch_errors)
+            print(f"resumed from {path} (epoch {start_epoch})")
+
+    metrics = MetricsLogger(path=args.metrics) if args.metrics else None
+    remaining = max(cfg.train.epochs - start_epoch, 0)
+    run_cfg = cfg.replace(
+        train=dataclasses.replace(cfg.train, epochs=remaining)
+    )
+
+    def on_epoch(epoch: int, epoch_params, err: float) -> None:
+        """Mid-training persistence: fires after every epoch, so a killed
+        run resumes from its last finished epoch, not from nothing."""
+        error_history.append(err)
+        if metrics:
+            metrics.record(event="epoch", epoch=epoch, error=err)
+        if args.checkpoint_dir:
+            checkpoint.save(
+                os.path.join(args.checkpoint_dir, f"ckpt_{epoch}.npz"),
+                epoch_params,
+                checkpoint.TrainState(
+                    epoch=epoch, epoch_errors=list(error_history)
+                ),
+            )
+
+    result = trainer.learn(
+        run_cfg,
+        train_ds,
+        params=params,
+        epoch_offset=start_epoch,
+        epoch_callback=on_epoch,
+    )
+
+    rate = trainer.test(result.params, test_ds)
+    if metrics:
+        n_images = len(train_ds) * max(len(result.epoch_errors), 1)
+        metrics.record(
+            event="final",
+            error_rate=rate,
+            seconds=result.seconds,
+            images_per_sec=throughput(n_images, result.seconds),
+        )
+        metrics.close()
+
+    if args.profile:
+        bsz = max(cfg.train.batch_size, 256)
+        xs = jnp.asarray(train_ds.images[:bsz])
+        ys = jnp.asarray(train_ds.labels[:bsz])
+        phases = profiling.profile_phases(result.params, xs, ys)
+        print(profiling.report(phases, n_images=xs.shape[0]))
+
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

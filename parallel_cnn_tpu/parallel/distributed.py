@@ -1,0 +1,76 @@
+"""Multi-host bring-up conveniences — the ``mpirun`` analog (SURVEY.md §2.4).
+
+The reference launches its distributed backend with `mpirun -np N` +
+`MPI_Init` (MPI/Main.cpp:44) and discovers rank/size per kernel call
+(MPI/layer.h:163-167). The JAX-native core is `mesh.distributed_init`
+(idempotent wrapper over `jax.distributed.initialize`); this module adds
+the launcher-facing layer:
+
+- env-var configuration (PCNN_COORDINATOR / PCNN_NUM_PROCESSES /
+  PCNN_PROCESS_ID), the analog of mpirun's rank/size injection, plus
+  PCNN_AUTO_DISTRIBUTED=1 for TPU-pod auto-detection (where all three
+  parameters come from the TPU metadata service);
+- a safe single-process no-op default, so the same entry point runs
+  everywhere from a laptop CPU to a pod slice;
+- a rank/size surface (≙ MPI_Comm_rank / MPI_Comm_size).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+
+from parallel_cnn_tpu.parallel import mesh as mesh_lib
+
+log = logging.getLogger(__name__)
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    auto: Optional[bool] = None,
+) -> bool:
+    """Join the multi-process runtime when configured; returns True if so.
+
+    Explicit args win; else PCNN_* env vars; else, when `auto` (or
+    PCNN_AUTO_DISTRIBUTED=1), TPU-pod auto-detection via a bare
+    jax.distributed.initialize(). With none of those, single-process no-op
+    — genuine bring-up failures propagate (fail fast like MPI_Init).
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "PCNN_COORDINATOR"
+    )
+    if num_processes is None and "PCNN_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["PCNN_NUM_PROCESSES"])
+    if process_id is None and "PCNN_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["PCNN_PROCESS_ID"])
+    if auto is None:
+        auto = os.environ.get("PCNN_AUTO_DISTRIBUTED") == "1"
+
+    if num_processes is not None and num_processes <= 1:
+        return False
+    if coordinator_address is None and num_processes is None and not auto:
+        return False
+
+    mesh_lib.distributed_init(coordinator_address, num_processes, process_id)
+    log.info(
+        "distributed: process %d/%d, %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        len(jax.devices()),
+    )
+    return True
+
+
+def process_info() -> dict:
+    """rank/size surface (≙ MPI_Comm_rank / MPI_Comm_size)."""
+    return {
+        "process_id": jax.process_index(),
+        "num_processes": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }

@@ -1,0 +1,135 @@
+"""Checkpoint / resume (a capability gap in the reference — SURVEY.md §5:
+"Weights live only in process memory; training is one-shot").
+
+Format: one .npz per checkpoint holding the flattened params pytree (keys
+are '/'-joined tree paths) plus a JSON metadata blob (step counter, epoch
+errors so far, format version). Atomic write (tmp + rename) so a killed
+process never leaves a torn checkpoint — the failure-recovery story the
+reference lacks entirely.
+
+Kept dependency-light on purpose: these models are KBs, so a synchronous
+npz is strictly simpler and as fast as an async orbax manager; the API
+mirrors the save/restore shape an orbax swap-in would need if the model
+zoo outgrows it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+@dataclass
+class TrainState:
+    """What resume needs beyond the weights."""
+
+    epoch: int = 0
+    epoch_errors: List[float] = field(default_factory=list)
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+def _flatten(params) -> Dict[str, np.ndarray]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        key = "/".join(
+            str(getattr(p, "key", getattr(p, "idx", p))) for p in path
+        )
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def save(path: str, params, state: Optional[TrainState] = None) -> None:
+    """Atomically write params (+ train state) to `path` (.npz)."""
+    state = state or TrainState()
+    meta = {
+        "version": FORMAT_VERSION,
+        "epoch": state.epoch,
+        "epoch_errors": state.epoch_errors,
+        "extra": state.extra,
+    }
+    arrays = _flatten(params)
+    arrays["__meta__"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8
+    )
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(path) or ".", suffix=".tmp.npz"
+    )
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def restore(path: str, like) -> Tuple[Any, TrainState]:
+    """Load a checkpoint into the structure of `like` (a params pytree).
+
+    Validates that the stored keys/shapes/dtypes exactly match `like` —
+    a renamed layer or changed shape is a hard error, not a silent
+    partial load.
+    """
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        if meta.get("version") != FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint version {meta.get('version')} != {FORMAT_VERSION}"
+            )
+        stored = {k: z[k] for k in z.files if k != "__meta__"}
+
+    want = _flatten(like)
+    if set(stored) != set(want):
+        missing = set(want) - set(stored)
+        surplus = set(stored) - set(want)
+        raise ValueError(
+            f"checkpoint structure mismatch: missing={sorted(missing)} "
+            f"surplus={sorted(surplus)}"
+        )
+    for k, w in want.items():
+        if stored[k].shape != w.shape or stored[k].dtype != w.dtype:
+            raise ValueError(
+                f"checkpoint leaf '{k}' is {stored[k].shape}/{stored[k].dtype}"
+                f", expected {w.shape}/{w.dtype}"
+            )
+
+    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(like)
+    new_leaves = []
+    for path_keys, _ in leaves_with_path:
+        key = "/".join(
+            str(getattr(p, "key", getattr(p, "idx", p))) for p in path_keys
+        )
+        new_leaves.append(jax.numpy.asarray(stored[key]))
+    params = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    state = TrainState(
+        epoch=meta["epoch"],
+        epoch_errors=list(meta["epoch_errors"]),
+        extra=dict(meta["extra"]),
+    )
+    return params, state
+
+
+def latest(directory: str, prefix: str = "ckpt_") -> Optional[str]:
+    """Path of the highest-epoch checkpoint in `directory`, or None."""
+    if not os.path.isdir(directory):
+        return None
+    best, best_epoch = None, -1
+    for name in os.listdir(directory):
+        if name.startswith(prefix) and name.endswith(".npz"):
+            try:
+                epoch = int(name[len(prefix):-4])
+            except ValueError:
+                continue
+            if epoch > best_epoch:
+                best, best_epoch = os.path.join(directory, name), epoch
+    return best

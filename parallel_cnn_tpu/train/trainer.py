@@ -51,12 +51,20 @@ def learn(
     train: pipeline.Dataset,
     params: Optional[step_lib.Params] = None,
     verbose: bool = True,
+    epoch_offset: int = 0,
+    epoch_callback=None,
 ) -> TrainResult:
     """≙ learn() (Sequential/Main.cpp:146-184): epoch loop with mean
     err-norm metric and threshold early-stop.
 
     batch_size == 1 → strict-parity scan (per-sample SGD, the reference
     trajectory); batch_size > 1 → minibatch steps.
+
+    `epoch_offset` shifts the per-epoch derived seeds so a resumed run
+    shuffles exactly like the continuous run it restarts (pass the number
+    of epochs already completed). `epoch_callback(epoch, params, err)` —
+    with `epoch` global (offset included, 1-based) — fires after every
+    epoch; use it for mid-training checkpoints and metrics.
     """
     tc = cfg.train
     if params is None:
@@ -81,7 +89,7 @@ def learn(
         # Per-epoch derived seed: every path reshuffles each epoch (and all
         # paths draw the same epoch boundary semantics — an epoch is one
         # pass from index 0, shuffled or in file order).
-        epoch_seed = tc.seed + epoch
+        epoch_seed = tc.seed + epoch_offset + epoch
         with sw:
             if tc.batch_size == 1:
                 if tc.shuffle:
@@ -133,6 +141,8 @@ def learn(
                 err = jnp.sum(jnp.stack(errs) * w) / jnp.sum(w)
             err = float(err)  # blocks: everything above is async
         result.epoch_errors.append(err)
+        if epoch_callback is not None:
+            epoch_callback(epoch_offset + epoch + 1, params, err)
         if verbose:
             # ≙ fprintf at Sequential/Main.cpp:174
             print(f"error: {err:e}, time_on_cpu: {sw.total:f}")

@@ -1,0 +1,67 @@
+"""Structured training metrics (replaces the reference's bare printf
+telemetry — `error: %e, time_on_cpu: %lf` at Sequential/Main.cpp:174 —
+with machine-readable records; SURVEY.md §5 "Metrics / logging").
+
+One JSONL record per event: {"step": …, "epoch": …, metrics…, "ts": …}.
+Sinks compose: file (JSONL), stdout, and an in-memory buffer for tests and
+notebook use. Scalars are coerced to Python floats (device arrays block
+until ready exactly once, at record time — sync-correct like utils/timing).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, TextIO
+
+
+def _scalar(v: Any) -> Any:
+    if isinstance(v, (int, str, bool)) or v is None:
+        return v
+    return float(v)  # numpy / jax scalars (blocks on device values)
+
+
+class MetricsLogger:
+    """Append-only metrics sink."""
+
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        echo: bool = False,
+        keep_in_memory: bool = True,
+    ):
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._file: Optional[TextIO] = open(path, "a") if path else None
+        self._echo = echo
+        self.records: List[Dict[str, Any]] = [] if keep_in_memory else None
+
+    def record(self, **values: Any) -> Dict[str, Any]:
+        rec = {k: _scalar(v) for k, v in values.items()}
+        rec["ts"] = time.time()
+        if self.records is not None:
+            self.records.append(rec)
+        line = json.dumps(rec)
+        if self._file:
+            self._file.write(line + "\n")
+            self._file.flush()
+        if self._echo:
+            print(line)
+        return rec
+
+    def close(self) -> None:
+        if self._file:
+            self._file.close()
+            self._file = None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def throughput(n_items: int, seconds: float) -> float:
+    """items/sec with a zero-guard."""
+    return n_items / seconds if seconds > 0 else float("inf")

@@ -1,0 +1,132 @@
+"""Aux-subsystem tests: checkpoint/resume, metrics, per-phase profiling,
+CLI driver, distributed no-op init (SURVEY.md §5 gaps the framework fills).
+"""
+
+import json
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from parallel_cnn_tpu.models import lenet_ref
+from parallel_cnn_tpu.train import checkpoint
+from parallel_cnn_tpu.utils import profiling
+from parallel_cnn_tpu.utils.metrics import MetricsLogger
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    params = lenet_ref.init(jax.random.key(1))
+    state = checkpoint.TrainState(epoch=3, epoch_errors=[0.5, 0.3, 0.2])
+    path = str(tmp_path / "ckpt_3.npz")
+    checkpoint.save(path, params, state)
+    like = lenet_ref.init(jax.random.key(2))  # different values, same shape
+    restored, rstate = checkpoint.restore(path, like)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(params),
+        jax.tree_util.tree_leaves(restored),
+        strict=True,
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert rstate.epoch == 3
+    assert rstate.epoch_errors == [0.5, 0.3, 0.2]
+
+
+def test_checkpoint_structure_mismatch_is_error(tmp_path):
+    params = lenet_ref.init(jax.random.key(1))
+    path = str(tmp_path / "ckpt_1.npz")
+    checkpoint.save(path, params)
+    bad = {"c1": params["c1"]}  # missing layers
+    with pytest.raises(ValueError, match="structure mismatch"):
+        checkpoint.restore(path, bad)
+    reshaped = jax.tree_util.tree_map(lambda x: x, params)
+    reshaped["f"]["w"] = jnp.zeros((5, 216), jnp.float32)
+    with pytest.raises(ValueError, match="expected"):
+        checkpoint.restore(path, reshaped)
+
+
+def test_checkpoint_latest(tmp_path):
+    params = lenet_ref.init(jax.random.key(0))
+    assert checkpoint.latest(str(tmp_path)) is None
+    for e in (1, 2, 10):
+        checkpoint.save(str(tmp_path / f"ckpt_{e}.npz"), params)
+    assert checkpoint.latest(str(tmp_path)).endswith("ckpt_10.npz")
+
+
+def test_metrics_logger(tmp_path):
+    path = str(tmp_path / "metrics.jsonl")
+    with MetricsLogger(path=path) as m:
+        m.record(event="epoch", epoch=1, error=jnp.float32(0.25))
+        m.record(event="final", error_rate=1.5)
+    lines = [json.loads(l) for l in open(path)]
+    assert lines[0]["error"] == 0.25 and isinstance(lines[0]["error"], float)
+    assert lines[1]["event"] == "final"
+    assert m.records[0]["epoch"] == 1
+
+
+def test_profile_phases_shape():
+    params = lenet_ref.init(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    xs = jnp.asarray(rng.uniform(0, 1, (32, 28, 28)).astype(np.float32))
+    ys = jnp.asarray(rng.integers(0, 10, (32,)).astype(np.int32))
+    phases = profiling.profile_phases(params, xs, ys, repeats=2)
+    assert set(phases) == {"conv", "pool", "fc", "grad", "total_forward"}
+    assert all(v > 0 for v in phases.values())
+    table = profiling.report(phases, n_images=32)
+    assert "conv" in table and "images/sec" in table
+
+
+def test_distributed_single_process_noop(monkeypatch):
+    from parallel_cnn_tpu.parallel import distributed
+
+    for var in ("PCNN_COORDINATOR", "PCNN_NUM_PROCESSES", "PCNN_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    assert distributed.initialize() is False
+    info = distributed.process_info()
+    assert info["num_processes"] == 1 and info["process_id"] == 0
+
+
+def _run_cli(args, env_extra=None):
+    import os
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, "-m", "parallel_cnn_tpu", *args],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=300,
+    )
+
+
+@pytest.mark.slow
+def test_cli_end_to_end_with_checkpoint_resume(tmp_path):
+    ckpt = str(tmp_path / "ckpts")
+    metrics = str(tmp_path / "m.jsonl")
+    base = [
+        "--loader", "synthetic",
+        "--synthetic-train-count", "512",
+        "--synthetic-test-count", "128",
+        "--batch-size", "64",
+        "--epochs", "2",
+        "--checkpoint-dir", ckpt,
+        "--metrics", metrics,
+    ]
+    r = _run_cli(base)
+    assert r.returncode == 0, r.stderr
+    assert "Learning" in r.stdout and "Error Rate:" in r.stdout
+    assert checkpoint.latest(ckpt).endswith("ckpt_2.npz")
+    recs = [json.loads(l) for l in open(metrics)]
+    assert recs[-1]["event"] == "final"
+
+    # resume: asks for 3 epochs total, 2 already done → exactly 1 more
+    r2 = _run_cli(base[:-4] + ["--epochs", "3", "--resume",
+                               "--checkpoint-dir", ckpt])
+    assert r2.returncode == 0, r2.stderr
+    assert "resumed from" in r2.stdout
+    assert r2.stdout.count("error:") == 1
