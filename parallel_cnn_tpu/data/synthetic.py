@@ -63,3 +63,46 @@ def make_dataset(
                 out[mask] = np.roll(images[mask], (dy, dx), axis=(1, 2))
     out += rng.normal(0.0, noise, size=out.shape).astype(np.float32)
     return np.clip(out, 0.0, 1.0), labels
+
+
+def make_image_dataset(
+    count: int,
+    hw: Tuple[int, int] = (32, 32),
+    channels: int = 3,
+    classes: int = 10,
+    seed: int = 1234,
+    noise: float = 0.1,
+    proto_seed: int = 99,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Generic NHWC synthetic image classification set (CIFAR/ImageNet
+    stand-ins for the model-zoo configs — this environment has no egress,
+    so real CIFAR/ImageNet can't be fetched; shapes and class structure are
+    what the zoo trainer and benches need).
+
+    Returns (images (N,H,W,C) float32 in [0,1], labels (N,) int32).
+    """
+    h, w = hw
+    prng = np.random.default_rng(proto_seed)
+    # per-class smooth prototypes: low-res noise upsampled → soft blobs.
+    # ceil-divide so the 4× kron always covers (h, w) before the crop.
+    low = prng.uniform(
+        0, 1, size=(classes, -(-h // 4), -(-w // 4), channels)
+    )
+    protos = np.stack(
+        [
+            np.stack(
+                [
+                    np.kron(low[c, :, :, ch], np.ones((4, 4)))[:h, :w]
+                    for ch in range(channels)
+                ],
+                axis=-1,
+            )
+            for c in range(classes)
+        ]
+    ).astype(np.float32)
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, classes, size=count).astype(np.int32)
+    images = protos[labels] + rng.normal(0, noise, size=(count, h, w, channels)).astype(
+        np.float32
+    )
+    return np.clip(images, 0.0, 1.0), labels

@@ -1,0 +1,37 @@
+"""The 3-conv-block CIFAR-10 CNN (BASELINE.json config #3:
+"3-conv-block CNN on CIFAR-10 (32x32x3), DP over v5e-8")."""
+
+from __future__ import annotations
+
+from parallel_cnn_tpu.nn.core import Sequential
+from parallel_cnn_tpu.nn.layers import (
+    BatchNorm,
+    Conv2D,
+    Dense,
+    Flatten,
+    MaxPool,
+    ReLU,
+)
+
+IN_SHAPE = (32, 32, 3)
+NUM_CLASSES = 10
+
+
+def cifar_cnn(num_classes: int = NUM_CLASSES) -> Sequential:
+    """conv-bn-relu ×2 per block, 3 blocks (32→64→128 ch), maxpool between,
+    dense head — the standard compact CIFAR baseline."""
+
+    def block(ch):
+        return [
+            Conv2D(ch),
+            BatchNorm(),
+            ReLU(),
+            Conv2D(ch),
+            BatchNorm(),
+            ReLU(),
+            MaxPool(),
+        ]
+
+    return Sequential(
+        [*block(32), *block(64), *block(128), Flatten(), Dense(num_classes)]
+    )

@@ -1,0 +1,59 @@
+"""Module protocol + Sequential combinator.
+
+A Module is a value (dataclass) with two pure functions:
+
+    init(key, in_shape) -> (params, state, out_shape)
+    apply(params, state, x, train) -> (y, new_state)
+
+`in_shape`/`out_shape` are per-sample shapes (no batch dim); `x` is always
+batched (N, ...). params hold trainables; state holds non-trainables
+(BatchNorm running stats). Layers without params/state use empty dicts so
+pytree structures stay uniform and checkpoint/optimizer code needs no
+special cases.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Sequence, Tuple
+
+import jax
+
+Params = Any
+State = Any
+Shape = Tuple[int, ...]
+
+
+class Module:
+    """Base class (interface only — subclasses are frozen dataclasses)."""
+
+    def init(self, key: jax.Array, in_shape: Shape):
+        raise NotImplementedError
+
+    def apply(self, params, state, x, train: bool = False):
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class Sequential(Module):
+    """Compose modules; params/state are lists aligned with `layers`."""
+
+    layers: Sequence[Module]
+
+    def init(self, key: jax.Array, in_shape: Shape):
+        params: List[Params] = []
+        state: List[State] = []
+        shape = in_shape
+        keys = jax.random.split(key, max(len(self.layers), 1))
+        for layer, k in zip(self.layers, keys):
+            p, s, shape = layer.init(k, shape)
+            params.append(p)
+            state.append(s)
+        return params, state, shape
+
+    def apply(self, params, state, x, train: bool = False):
+        new_state: List[State] = []
+        for layer, p, s in zip(self.layers, params, state, strict=True):
+            x, s = layer.apply(p, s, x, train)
+            new_state.append(s)
+        return x, new_state

@@ -1,0 +1,212 @@
+"""Standard layers, NHWC, TPU-first.
+
+Convs lower to `lax.conv_general_dilated` with NHWC/HWIO dimension numbers
+— channels-last keeps the channel dim on the lane axis of the MXU so XLA
+tiles 8×128 without transposes. BatchNorm means are plain batch means: in
+GSPMD data-parallel training (jit + batch sharded over the mesh's data
+axis) XLA turns them into global cross-replica means automatically — no
+explicit psum needed (contrast the reference's hand-placed MPI_Reduce per
+kernel, MPI/layer.h)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from parallel_cnn_tpu.nn.core import Module, Shape
+
+
+def _he_normal(key, shape, fan_in, dtype):
+    return jax.random.normal(key, shape, dtype) * jnp.sqrt(2.0 / fan_in)
+
+
+@dataclasses.dataclass(frozen=True)
+class Conv2D(Module):
+    """features × (kh, kw) conv, stride/padding configurable, He init."""
+
+    features: int
+    kernel: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (1, 1)
+    padding: str = "SAME"
+    use_bias: bool = True
+
+    def init(self, key, in_shape: Shape):
+        h, w, c = in_shape
+        kh, kw = self.kernel
+        wkey, _ = jax.random.split(key)
+        fan_in = kh * kw * c
+        params = {
+            "w": _he_normal(wkey, (kh, kw, c, self.features), fan_in, jnp.float32)
+        }
+        if self.use_bias:
+            params["b"] = jnp.zeros((self.features,), jnp.float32)
+        out = lax.conv_general_shape_tuple(
+            (1, h, w, c),
+            (kh, kw, c, self.features),
+            self.strides,
+            self.padding,
+            ("NHWC", "HWIO", "NHWC"),
+        )
+        return params, {}, tuple(out[1:])
+
+    def apply(self, params, state, x, train: bool = False):
+        y = lax.conv_general_dilated(
+            x,
+            params["w"].astype(x.dtype),
+            self.strides,
+            self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if self.use_bias:
+            y = y + params["b"].astype(x.dtype)
+        return y, state
+
+
+@dataclasses.dataclass(frozen=True)
+class Dense(Module):
+    features: int
+
+    def init(self, key, in_shape: Shape):
+        (d,) = in_shape
+        wkey, _ = jax.random.split(key)
+        params = {
+            "w": _he_normal(wkey, (d, self.features), d, jnp.float32),
+            "b": jnp.zeros((self.features,), jnp.float32),
+        }
+        return params, {}, (self.features,)
+
+    def apply(self, params, state, x, train: bool = False):
+        return x @ params["w"].astype(x.dtype) + params["b"].astype(x.dtype), state
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchNorm(Module):
+    """Running-stats batch norm; stats update only when train=True.
+
+    The batch mean/var are global under GSPMD data parallelism (XLA
+    all-reduces them when the batch is sharded) — true sync-BN for free.
+    """
+
+    momentum: float = 0.9
+    eps: float = 1e-5
+
+    def init(self, key, in_shape: Shape):
+        c = in_shape[-1]
+        params = {
+            "scale": jnp.ones((c,), jnp.float32),
+            "bias": jnp.zeros((c,), jnp.float32),
+        }
+        state = {
+            "mean": jnp.zeros((c,), jnp.float32),
+            "var": jnp.ones((c,), jnp.float32),
+        }
+        return params, state, in_shape
+
+    def apply(self, params, state, x, train: bool = False):
+        axes = tuple(range(x.ndim - 1))
+        if train:
+            mean = jnp.mean(x.astype(jnp.float32), axis=axes)
+            var = jnp.var(x.astype(jnp.float32), axis=axes)
+            m = self.momentum
+            state = {
+                "mean": m * state["mean"] + (1 - m) * mean,
+                "var": m * state["var"] + (1 - m) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+        inv = lax.rsqrt(var + self.eps) * params["scale"]
+        y = (x.astype(jnp.float32) - mean) * inv + params["bias"]
+        return y.astype(x.dtype), state
+
+
+@dataclasses.dataclass(frozen=True)
+class ReLU(Module):
+    def init(self, key, in_shape: Shape):
+        return {}, {}, in_shape
+
+    def apply(self, params, state, x, train: bool = False):
+        return jax.nn.relu(x), state
+
+
+def _pool_out(in_shape: Shape, window, strides, padding) -> Shape:
+    h, w, c = in_shape
+    if padding == "SAME":
+        oh = -(-h // strides[0])
+        ow = -(-w // strides[1])
+    else:
+        oh = (h - window[0]) // strides[0] + 1
+        ow = (w - window[1]) // strides[1] + 1
+    return (oh, ow, c)
+
+
+@dataclasses.dataclass(frozen=True)
+class MaxPool(Module):
+    window: Tuple[int, int] = (2, 2)
+    strides: Tuple[int, int] = (2, 2)
+    padding: str = "VALID"
+
+    def init(self, key, in_shape: Shape):
+        return {}, {}, _pool_out(in_shape, self.window, self.strides, self.padding)
+
+    def apply(self, params, state, x, train: bool = False):
+        y = lax.reduce_window(
+            x,
+            -jnp.inf,
+            lax.max,
+            (1, *self.window, 1),
+            (1, *self.strides, 1),
+            self.padding,
+        )
+        return y, state
+
+
+@dataclasses.dataclass(frozen=True)
+class AvgPool(Module):
+    window: Tuple[int, int] = (2, 2)
+    strides: Tuple[int, int] = (2, 2)
+    padding: str = "VALID"
+
+    def init(self, key, in_shape: Shape):
+        return {}, {}, _pool_out(in_shape, self.window, self.strides, self.padding)
+
+    def apply(self, params, state, x, train: bool = False):
+        dims = (1, *self.window, 1)
+        strides = (1, *self.strides, 1)
+        y = lax.reduce_window(
+            x, jnp.zeros((), x.dtype), lax.add, dims, strides, self.padding
+        )
+        if self.padding == "SAME":
+            # Edge windows overlap padding: divide by the per-window count
+            # of VALID elements, not the full window size.
+            ones = jnp.ones(x.shape[1:3], x.dtype)[None, :, :, None]
+            counts = lax.reduce_window(
+                ones, jnp.zeros((), x.dtype), lax.add, dims, strides,
+                self.padding,
+            )
+            return y / counts, state
+        return y / (self.window[0] * self.window[1]), state
+
+
+@dataclasses.dataclass(frozen=True)
+class GlobalAvgPool(Module):
+    def init(self, key, in_shape: Shape):
+        return {}, {}, (in_shape[-1],)
+
+    def apply(self, params, state, x, train: bool = False):
+        return jnp.mean(x, axis=(1, 2)), state
+
+
+@dataclasses.dataclass(frozen=True)
+class Flatten(Module):
+    def init(self, key, in_shape: Shape):
+        size = 1
+        for d in in_shape:
+            size *= d
+        return {}, {}, (size,)
+
+    def apply(self, params, state, x, train: bool = False):
+        return x.reshape(x.shape[0], -1), state

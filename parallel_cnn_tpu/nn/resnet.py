@@ -1,0 +1,184 @@
+"""ResNet family (BASELINE.json configs #4/#5: ResNet-18 on CIFAR-10,
+ResNet-50 on ImageNet-1k) — He et al. 2016, built from this package's
+layers with a functional residual-block module.
+
+CIFAR variants use the 3×3/stride-1 stem (no maxpool); ImageNet variants
+the 7×7/stride-2 stem + 3×3 maxpool, per the paper."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence, Tuple
+
+import jax
+
+from parallel_cnn_tpu.nn.core import Module, Sequential, Shape
+from parallel_cnn_tpu.nn.layers import (
+    BatchNorm,
+    Conv2D,
+    Dense,
+    GlobalAvgPool,
+    MaxPool,
+    ReLU,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class BasicBlock(Module):
+    """Two 3×3 convs + identity/projection shortcut (ResNet-18/34)."""
+
+    features: int
+    stride: int = 1
+
+    def _branches(self):
+        main = Sequential(
+            [
+                Conv2D(self.features, strides=(self.stride, self.stride), use_bias=False),
+                BatchNorm(),
+                ReLU(),
+                Conv2D(self.features, use_bias=False),
+                BatchNorm(),
+            ]
+        )
+        proj = Sequential(
+            [
+                Conv2D(
+                    self.features,
+                    kernel=(1, 1),
+                    strides=(self.stride, self.stride),
+                    use_bias=False,
+                ),
+                BatchNorm(),
+            ]
+        )
+        return main, proj
+
+    def init(self, key, in_shape: Shape):
+        main, proj = self._branches()
+        k1, k2 = jax.random.split(key)
+        mp, ms, out_shape = main.init(k1, in_shape)
+        params = {"main": mp}
+        state = {"main": ms}
+        if self.stride != 1 or in_shape[-1] != self.features:
+            pp, ps, _ = proj.init(k2, in_shape)
+            params["proj"] = pp
+            state["proj"] = ps
+        return params, state, out_shape
+
+    def apply(self, params, state, x, train: bool = False):
+        main, proj = self._branches()
+        y, ms = main.apply(params["main"], state["main"], x, train)
+        new_state = {"main": ms}
+        if "proj" in params:
+            sc, ps = proj.apply(params["proj"], state["proj"], x, train)
+            new_state["proj"] = ps
+        else:
+            sc = x
+        return jax.nn.relu(y + sc), new_state
+
+
+@dataclasses.dataclass(frozen=True)
+class Bottleneck(Module):
+    """1×1 → 3×3 → 1×1(×4) bottleneck (ResNet-50/101/152)."""
+
+    features: int  # bottleneck width; output is 4× this
+    stride: int = 1
+    EXPANSION = 4
+
+    def _branches(self):
+        out_ch = self.features * self.EXPANSION
+        main = Sequential(
+            [
+                Conv2D(self.features, kernel=(1, 1), use_bias=False),
+                BatchNorm(),
+                ReLU(),
+                Conv2D(
+                    self.features,
+                    strides=(self.stride, self.stride),
+                    use_bias=False,
+                ),
+                BatchNorm(),
+                ReLU(),
+                Conv2D(out_ch, kernel=(1, 1), use_bias=False),
+                BatchNorm(),
+            ]
+        )
+        proj = Sequential(
+            [
+                Conv2D(
+                    out_ch,
+                    kernel=(1, 1),
+                    strides=(self.stride, self.stride),
+                    use_bias=False,
+                ),
+                BatchNorm(),
+            ]
+        )
+        return main, proj
+
+    def init(self, key, in_shape: Shape):
+        main, proj = self._branches()
+        k1, k2 = jax.random.split(key)
+        mp, ms, out_shape = main.init(k1, in_shape)
+        params = {"main": mp}
+        state = {"main": ms}
+        if self.stride != 1 or in_shape[-1] != self.features * self.EXPANSION:
+            pp, ps, _ = proj.init(k2, in_shape)
+            params["proj"] = pp
+            state["proj"] = ps
+        return params, state, out_shape
+
+    def apply(self, params, state, x, train: bool = False):
+        main, proj = self._branches()
+        y, ms = main.apply(params["main"], state["main"], x, train)
+        new_state = {"main": ms}
+        if "proj" in params:
+            sc, ps = proj.apply(params["proj"], state["proj"], x, train)
+            new_state["proj"] = ps
+        else:
+            sc = x
+        return jax.nn.relu(y + sc), new_state
+
+
+def _stage(block_cls, features: int, count: int, stride: int) -> Sequence[Module]:
+    return [
+        block_cls(features, stride if i == 0 else 1) for i in range(count)
+    ]
+
+
+def _resnet(
+    block_cls,
+    stage_sizes: Sequence[int],
+    num_classes: int,
+    cifar_stem: bool,
+) -> Sequential:
+    if cifar_stem:
+        stem = [Conv2D(64, use_bias=False), BatchNorm(), ReLU()]
+    else:
+        stem = [
+            Conv2D(64, kernel=(7, 7), strides=(2, 2), use_bias=False),
+            BatchNorm(),
+            ReLU(),
+            MaxPool(window=(3, 3), strides=(2, 2), padding="SAME"),
+        ]
+    layers = list(stem)
+    for i, (features, count) in enumerate(zip((64, 128, 256, 512), stage_sizes)):
+        layers += _stage(block_cls, features, count, stride=1 if i == 0 else 2)
+    layers += [GlobalAvgPool(), Dense(num_classes)]
+    return Sequential(layers)
+
+
+def resnet18(num_classes: int = 10, cifar_stem: bool = True) -> Sequential:
+    return _resnet(BasicBlock, (2, 2, 2, 2), num_classes, cifar_stem)
+
+
+def resnet34(num_classes: int = 10, cifar_stem: bool = True) -> Sequential:
+    return _resnet(BasicBlock, (3, 4, 6, 3), num_classes, cifar_stem)
+
+
+def resnet50(num_classes: int = 1000, cifar_stem: bool = False) -> Sequential:
+    return _resnet(Bottleneck, (3, 4, 6, 3), num_classes, cifar_stem)
+
+
+def num_params(params) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))

@@ -74,7 +74,7 @@ def profile_phases(
 ) -> Dict[str, float]:
     """Per-phase mean seconds for a batch (the paper's table decomposition).
 
-    Returns {"conv", "pool", "fc", "grad", "total_forward", "train_step"}.
+    Returns {"conv", "pool", "fc", "grad", "total_forward"}.
     """
     sigmoid = jax.nn.sigmoid
 

@@ -1,0 +1,149 @@
+"""Model-zoo tests: layer library, CIFAR CNN, ResNets, the GSPMD DP
+trainer, and gradient accumulation (BASELINE.json configs #3-#5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from parallel_cnn_tpu.config import MeshConfig
+from parallel_cnn_tpu.data import synthetic
+from parallel_cnn_tpu.nn import cifar, layers, resnet
+from parallel_cnn_tpu.parallel import mesh as mesh_lib
+from parallel_cnn_tpu.train import zoo
+
+
+def test_layer_shapes():
+    key = jax.random.key(0)
+    model = cifar.cifar_cnn()
+    params, state, out_shape = model.init(key, cifar.IN_SHAPE)
+    assert out_shape == (10,)
+    x = jnp.zeros((4, 32, 32, 3), jnp.float32)
+    logits, _ = model.apply(params, state, x)
+    assert logits.shape == (4, 10)
+
+
+@pytest.mark.parametrize(
+    "factory,in_shape,expected_params",
+    [
+        # torchvision resnet18 (ImageNet stem, 1000 classes): 11,689,512
+        (lambda: resnet.resnet18(1000, cifar_stem=False), (64, 64, 3), 11_689_512),
+        # torchvision resnet50 (1000 classes): 25,557,032
+        (lambda: resnet.resnet50(1000), (64, 64, 3), 25_557_032),
+    ],
+)
+def test_resnet_param_counts_match_torchvision(factory, in_shape, expected_params):
+    model = factory()
+    params, state, out_shape = model.init(jax.random.key(0), in_shape)
+    assert out_shape == (1000,)
+    assert resnet.num_params(params) == expected_params
+
+
+def test_resnet18_cifar_forward_and_bn_state():
+    model = resnet.resnet18(10, cifar_stem=True)
+    params, state, _ = model.init(jax.random.key(0), (32, 32, 3))
+    x = jnp.asarray(np.random.default_rng(0).uniform(size=(2, 32, 32, 3)), jnp.float32)
+    logits, new_state = model.apply(params, state, x, train=True)
+    assert logits.shape == (2, 10)
+    # train=True must move BN running stats; train=False must not
+    before = jax.tree_util.tree_leaves(state)
+    after = jax.tree_util.tree_leaves(new_state)
+    assert any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(before, after, strict=True)
+    )
+    _, frozen_state = model.apply(params, new_state, x, train=False)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(new_state),
+        jax.tree_util.tree_leaves(frozen_state),
+        strict=True,
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_cifar_cnn_learns_synthetic():
+    imgs, labels = synthetic.make_image_dataset(512, seed=1)
+    state, losses = zoo.train(
+        cifar.cifar_cnn(),
+        imgs,
+        labels,
+        in_shape=cifar.IN_SHAPE,
+        epochs=3,
+        batch_size=64,
+        lr=0.05,
+        verbose=False,
+    )
+    assert losses[-1] < losses[0] * 0.7, losses
+    ev = zoo.make_eval_step(cifar.cifar_cnn())
+    correct = int(
+        ev(state.params, state.model_state, jnp.asarray(imgs[:256]), jnp.asarray(labels[:256]))
+    )
+    assert correct > 128  # way above the 10% chance floor
+
+
+def test_grad_accumulation_matches_full_batch():
+    """accum_steps=4 must produce the same update as one full batch (BN
+    stats aside — compare params only, loss to tolerance)."""
+    imgs, labels = synthetic.make_image_dataset(64, seed=2)
+    x, y = jnp.asarray(imgs), jnp.asarray(labels)
+    model = cifar.cifar_cnn()
+    opt = zoo.make_optimizer(lr=0.1, momentum=0.0)
+
+    def one_step(accum):
+        st = zoo.init_state(model, jax.random.key(0), cifar.IN_SHAPE, opt)
+        step = zoo.make_train_step(model, opt, accum_steps=accum)
+        st, loss = step(st, x, y)
+        return st, float(loss)
+
+    s1, l1 = one_step(1)
+    s4, l4 = one_step(4)
+    # BN batch stats differ between one batch of 64 and four of 16, which
+    # perturbs the backward; tolerances reflect that equivalence gap.
+    np.testing.assert_allclose(l1, l4, rtol=0.05)
+    flat1 = jax.tree_util.tree_leaves(s1.params)
+    flat4 = jax.tree_util.tree_leaves(s4.params)
+    for a, b in zip(flat1, flat4, strict=True):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-2, rtol=0.5
+        )
+
+
+def test_zoo_dp_mesh_runs_and_matches_single_device():
+    """GSPMD DP on the 8-device CPU mesh computes the same step as one
+    device (same global batch, compiler-inserted collectives)."""
+    imgs, labels = synthetic.make_image_dataset(64, seed=3)
+    x, y = jnp.asarray(imgs), jnp.asarray(labels)
+    model = cifar.cifar_cnn()
+    opt = zoo.make_optimizer(lr=0.1, momentum=0.0)
+
+    mesh = mesh_lib.make_mesh(MeshConfig(data=8, model=1))
+    st = zoo.init_state(model, jax.random.key(0), cifar.IN_SHAPE, opt)
+    step_dp = zoo.make_train_step(model, opt, mesh=mesh)
+    st_dp, loss_dp = step_dp(st, x, y)
+
+    st1 = zoo.init_state(model, jax.random.key(0), cifar.IN_SHAPE, opt)
+    step_1 = zoo.make_train_step(model, opt)
+    st_1, loss_1 = step_1(st1, x, y)
+
+    np.testing.assert_allclose(float(loss_dp), float(loss_1), rtol=1e-5)
+    # f32 reduction order differs between the sharded (all-reduce tree) and
+    # single-device sums; 5e-4 abs covers that cross-sharding noise.
+    for a, b in zip(
+        jax.tree_util.tree_leaves(st_dp.params),
+        jax.tree_util.tree_leaves(st_1.params),
+        strict=True,
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
+def test_resnet50_imagenet_shape_smoke():
+    """Config #5 smoke: ResNet-50, ImageNet-ish input, grad accumulation."""
+    model = resnet.resnet50(num_classes=100)
+    imgs, labels = synthetic.make_image_dataset(
+        8, hw=(64, 64), classes=100, seed=4
+    )
+    opt = zoo.make_optimizer(lr=0.01)
+    st = zoo.init_state(model, jax.random.key(0), (64, 64, 3), opt)
+    step = zoo.make_train_step(model, opt, accum_steps=2)
+    st, loss = step(st, jnp.asarray(imgs), jnp.asarray(labels))
+    assert np.isfinite(float(loss))
