@@ -88,6 +88,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     cfg = config_from_args(args)
 
     import jax
+
+    # Reliable platform override: the ambient plugin snapshots JAX_PLATFORMS
+    # before user code (tests/conftest.py documents this), so the env var
+    # alone can't force CPU — jax.config.update can.
+    if os.environ.get("PCNN_JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["PCNN_JAX_PLATFORMS"])
     import jax.numpy as jnp
 
     from parallel_cnn_tpu.data import pipeline

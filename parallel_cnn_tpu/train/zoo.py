@@ -119,9 +119,20 @@ def make_train_step(
 
     def step(state: ZooState, x, y):
         if mesh is not None:
-            xsh = NamedSharding(mesh, P(DATA_AXIS))
-            x = jax.lax.with_sharding_constraint(x, xsh)
-            y = jax.lax.with_sharding_constraint(y, NamedSharding(mesh, P(DATA_AXIS)))
+            data_sh = NamedSharding(mesh, P(DATA_AXIS))
+            x = jax.lax.with_sharding_constraint(x, data_sh)
+            y = jax.lax.with_sharding_constraint(y, data_sh)
+            # Pin params replicated so the gradient all-reduce lands over
+            # the data axis even under future multi-axis meshes.
+            repl = NamedSharding(mesh, P())
+            state = ZooState(
+                jax.tree_util.tree_map(
+                    lambda p: jax.lax.with_sharding_constraint(p, repl),
+                    state.params,
+                ),
+                state.model_state,
+                state.opt_state,
+            )
         loss, model_state, grads = microbatch_grads(
             state.params, state.model_state, x, y
         )
@@ -171,6 +182,10 @@ def train(
 
     n = images.shape[0]
     steps = n // batch_size
+    if steps == 0:
+        raise ValueError(
+            f"dataset of {n} samples yields zero batches of {batch_size}"
+        )
     images = jnp.asarray(images)
     labels = jnp.asarray(labels)
     losses = []

@@ -92,7 +92,11 @@ def _run_cli(args, env_extra=None):
     import os
 
     env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
+    # PCNN_JAX_PLATFORMS: honored via jax.config.update inside cli.main —
+    # the bare JAX_PLATFORMS env var is snapshotted away by the ambient
+    # platform plugin (see conftest.py), which would leave this subprocess
+    # trying to reach the (possibly absent) TPU tunnel.
+    env["PCNN_JAX_PLATFORMS"] = "cpu"
     if env_extra:
         env.update(env_extra)
     return subprocess.run(
