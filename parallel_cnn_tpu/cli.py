@@ -40,6 +40,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--shuffle", action="store_true")
     p.add_argument("--prefetch", default=t.prefetch,
                    choices=["auto", "native", "off"])
+    p.add_argument("--dtype", default=t.dtype,
+                   choices=["float32", "bfloat16"],
+                   help="compute dtype; bfloat16 = MXU-native mixed "
+                        "precision (batch_size>1 only)")
     p.add_argument("--synthetic-train-count", type=int,
                    default=d.synthetic_train_count)
     p.add_argument("--synthetic-test-count", type=int,
@@ -79,6 +83,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         seed=args.seed,
         shuffle=args.shuffle,
         prefetch=args.prefetch,
+        dtype=args.dtype,
     )
     return Config(data=data, train=train)
 

@@ -65,6 +65,14 @@ class TrainConfig:
     # the NumPy path runs the tail at its own shape.
     prefetch: str = "auto"
 
+    def __post_init__(self):
+        if self.batch_size == 1 and self.dtype != "float32":
+            raise ValueError(
+                "batch_size=1 is the strict-parity mode and is float32-only "
+                f"(got dtype={self.dtype!r}); use batch_size>1 for bf16 "
+                "throughput"
+            )
+
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:

@@ -53,14 +53,37 @@ def scan_epoch(params: Params, images: jax.Array, labels: jax.Array, dt: float) 
     return params, jnp.mean(errs)
 
 
-@functools.partial(jax.jit, static_argnames=("dt",), donate_argnums=(0,))
-def batched_step(params: Params, x: jax.Array, y: jax.Array, dt: float) -> Tuple[Params, jax.Array]:
+@functools.partial(
+    jax.jit, static_argnames=("dt", "compute_dtype"), donate_argnums=(0,)
+)
+def batched_step(
+    params: Params,
+    x: jax.Array,
+    y: jax.Array,
+    dt: float,
+    compute_dtype: str | None = None,
+) -> Tuple[Params, jax.Array]:
     """Minibatch step: vmapped reference grads, mean-reduced over the batch.
 
     x: (B, 28, 28), y: (B,). The mean (not sum) keeps the effective step
     size comparable to the per-sample mode across batch sizes.
+
+    compute_dtype="bfloat16" runs the forward/backward mixed-precision:
+    params stay float32 master weights, the compute path (and therefore
+    the MXU convs/contractions) runs bf16, and grads are cast back to f32
+    for the update. A documented throughput-mode deviation from the f32
+    reference numerics (SURVEY.md §2.1) — the strict-parity per-sample
+    path stays f32-only.
     """
-    errs, grads = jax.vmap(ops.value_and_ref_grads, in_axes=(None, 0, 0))(params, x, y)
+    # astype to the same dtype is a traced no-op, so one code path covers
+    # both modes; grads always come back f32 for the master-weight update.
+    cdt = jnp.dtype(compute_dtype or "float32")
+    cparams = jax.tree_util.tree_map(lambda p: p.astype(cdt), params)
+    errs, grads = jax.vmap(ops.value_and_ref_grads, in_axes=(None, 0, 0))(
+        cparams, x.astype(cdt), y
+    )
+    grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+    errs = errs.astype(jnp.float32)
     mean_grads = jax.tree_util.tree_map(lambda g: jnp.mean(g, axis=0), grads)
     return apply_grad(params, mean_grads, dt), jnp.mean(errs)
 

@@ -117,7 +117,11 @@ def learn(
                     for _ in range(steps_per_epoch):
                         bx, by = next(batcher)
                         params, e = step_lib.batched_step(
-                            params, jnp.asarray(bx), jnp.asarray(by), tc.dt
+                            params,
+                            jnp.asarray(bx),
+                            jnp.asarray(by),
+                            tc.dt,
+                            compute_dtype=tc.dtype,
                         )
                         errs.append(e)
                 err = jnp.mean(jnp.stack(errs))
@@ -133,7 +137,11 @@ def learn(
                     drop_remainder=False,
                 ):
                     params, e = step_lib.batched_step(
-                        params, jnp.asarray(bx), jnp.asarray(by), tc.dt
+                        params,
+                        jnp.asarray(bx),
+                        jnp.asarray(by),
+                        tc.dt,
+                        compute_dtype=tc.dtype,
                     )
                     errs.append(e)
                     weights.append(bx.shape[0])

@@ -74,3 +74,44 @@ def test_threshold_early_stop():
         cfg, Dataset(np.asarray(xs), np.asarray(ys)), verbose=False
     )
     assert res.stopped_early and len(res.epoch_errors) == 1
+
+
+def test_bf16_compute_dtype():
+    """Mixed-precision throughput mode: f32 master params, bf16 compute."""
+    params = lenet_ref.init(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.uniform(0, 1, (16, 28, 28)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 10, (16,)).astype(np.int32))
+
+    p32, e32 = step_lib.batched_step(
+        jax.tree_util.tree_map(jnp.array, params), x, y, 0.1
+    )
+    pbf, ebf = step_lib.batched_step(
+        jax.tree_util.tree_map(jnp.array, params), x, y, 0.1,
+        compute_dtype="bfloat16",
+    )
+    # master weights stay f32
+    assert all(
+        l.dtype == jnp.float32 for l in jax.tree_util.tree_leaves(pbf)
+    )
+    # bf16 trajectory tracks f32 loosely (bf16 has ~3 decimal digits)
+    np.testing.assert_allclose(float(ebf), float(e32), rtol=0.05)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(p32),
+        jax.tree_util.tree_leaves(pbf),
+        strict=True,
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=0.05
+        )
+
+
+def test_bf16_rejected_in_parity_mode():
+    """The constraint fails fast at config construction, before any data
+    loading or device work."""
+    import pytest
+
+    from parallel_cnn_tpu.config import TrainConfig
+
+    with pytest.raises(ValueError, match="float32-only"):
+        TrainConfig(batch_size=1, dtype="bfloat16")
