@@ -173,6 +173,47 @@ def bench_phases(quick: bool) -> List[Row]:
     return rows
 
 
+def bench_ops_paths(quick: bool) -> List[Row]:
+    """Path A (jnp/lax) vs path B (Pallas/Mosaic kernels) on the SAME
+    minibatch train step — the A-vs-B comparison the CUDA backend implies
+    by wiring its kernels into its driver (CUDA/main.cu:56-163). On TPU
+    path B is compiled Mosaic; elsewhere it runs the Pallas interpreter
+    (orders of magnitude slower — the row still proves numerical parity)."""
+    from parallel_cnn_tpu.models import lenet_ref
+    from parallel_cnn_tpu.train import step as step_lib
+    from parallel_cnn_tpu.utils.backend import canonical_platform
+
+    batch = 2048
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.uniform(0, 1, (batch, 28, 28)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 10, (batch,)).astype(np.int32))
+
+    on_tpu = canonical_platform() == "tpu"
+    repeats = (2 if quick else 5) if not on_tpu else (10 if quick else 30)
+    rows = []
+    paths = [("reference", step_lib.batched_step)]
+    # Interpreted Pallas at batch=2048 is minutes/step on CPU; bench the
+    # kernel path only where it compiles (TPU) unless explicitly forced.
+    if on_tpu or os.environ.get("PCNN_BENCH_PALLAS"):
+        paths.append(("pallas", step_lib.pallas_batched_step))
+    else:
+        print("[bench_ops_paths] pallas row skipped (no TPU; "
+              "set PCNN_BENCH_PALLAS=1 to force interpret mode)", flush=True)
+    for name, step in paths:
+        params = lenet_ref.init(jax.random.key(0))
+
+        def thunk(carry, step=step, params=params):
+            p = carry[0] if carry is not None else params
+            return step(p, x, y, 0.1)
+
+        sec = _sync_time(thunk, repeats=repeats)
+        rows.append(
+            Row(f"ops_{name}_step", round(batch / sec, 1), "images/sec",
+                EPOCH_IMAGES / CUDA_EPOCH_S, "CUDA Table 8").finish()
+        )
+    return rows
+
+
 def bench_dp_scaling(quick: bool) -> List[Row]:
     """DP scaling over the data mesh axis (≙ Tables 2-3's speedup/efficiency
     shape). Uses however many devices the platform exposes (8 virtual CPU
@@ -267,7 +308,7 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--suite",
         default="all",
-        choices=["all", "lenet", "phases", "dp", "zoo", "parity"],
+        choices=["all", "lenet", "phases", "dp", "zoo", "parity", "ops"],
     )
     args = ap.parse_args(argv)
 
@@ -275,6 +316,7 @@ def main(argv=None) -> int:
         "lenet": bench_lenet_throughput,
         "parity": bench_lenet_parity_epoch,
         "phases": bench_phases,
+        "ops": bench_ops_paths,
         "dp": bench_dp_scaling,
         "zoo": bench_zoo,
     }

@@ -109,7 +109,9 @@ extern "C" {
 void* pcnn_batcher_create(const float* images, const int32_t* labels, long n,
                           long batch, long depth, uint64_t seed,
                           int shuffle) {
-  if (n <= 0 || batch <= 0 || depth < 1) return nullptr;
+  // batch > n would wrap the cursor mid-batch and silently duplicate
+  // samples within one batch (reshuffling mid-batch under shuffle).
+  if (n <= 0 || batch <= 0 || batch > n || depth < 1) return nullptr;
   auto* b = new Batcher();
   b->images = images;
   b->labels = labels;
@@ -156,7 +158,14 @@ void pcnn_batcher_release(void* handle) {
 
 void pcnn_batcher_destroy(void* handle) {
   auto* b = static_cast<Batcher*>(handle);
-  b->stop.store(true);
+  {
+    // stop must be stored under mu: a thread that has evaluated its wait
+    // predicate (false) but not yet blocked would otherwise miss the
+    // notify — a lost wakeup that parks the worker forever and hangs
+    // worker.join() below.
+    std::lock_guard<std::mutex> lock(b->mu);
+    b->stop.store(true);
+  }
   b->cv_producer.notify_one();
   b->cv_consumer.notify_one();
   if (b->worker.joinable()) b->worker.join();

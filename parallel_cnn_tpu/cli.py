@@ -16,7 +16,7 @@ import dataclasses
 import os
 from typing import List, Optional
 
-from parallel_cnn_tpu.config import Config, DataConfig, TrainConfig
+from parallel_cnn_tpu.config import Config, DataConfig, MeshConfig, TrainConfig
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -44,10 +44,22 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["float32", "bfloat16"],
                    help="compute dtype; bfloat16 = MXU-native mixed "
                         "precision (batch_size>1 only)")
+    p.add_argument("--ops", default=t.ops,
+                   choices=["reference", "pallas"],
+                   help="kernel library: path A (jnp/lax, XLA-fused) or "
+                        "path B (hand-written Pallas/Mosaic kernels ≙ the "
+                        "CUDA backend; batch_size>1 only)")
     p.add_argument("--synthetic-train-count", type=int,
                    default=d.synthetic_train_count)
     p.add_argument("--synthetic-test-count", type=int,
                    default=d.synthetic_test_count)
+    p.add_argument("--mesh-data", type=int, default=None, metavar="N",
+                   help="data(-parallel) mesh axis size; setting either "
+                        "mesh flag routes minibatch training over the "
+                        "device mesh (≙ mpirun -np N, MPI/Main.cpp:44)")
+    p.add_argument("--mesh-model", type=int, default=None, metavar="N",
+                   help="model (intra-op) mesh axis size; must divide the "
+                        "6 conv filters (legal: 1, 2, 3, 6)")
     p.add_argument("--checkpoint-dir", default=None,
                    help="save ckpt_<epoch>.npz per epoch; --resume restarts "
                         "from the latest")
@@ -84,8 +96,14 @@ def config_from_args(args: argparse.Namespace) -> Config:
         shuffle=args.shuffle,
         prefetch=args.prefetch,
         dtype=args.dtype,
+        ops=args.ops,
     )
-    return Config(data=data, train=train)
+    # Either flag opts into mesh training; data=None means "all devices
+    # not claimed by model" (resolved at mesh build, after the platform
+    # override — no jax import may happen here). A bare `--mesh-model 1`
+    # is the single-device default and does not activate the mesh.
+    mesh = MeshConfig(data=args.mesh_data, model=args.mesh_model or 1)
+    return Config(data=data, train=train, mesh=mesh)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

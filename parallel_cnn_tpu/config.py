@@ -58,12 +58,20 @@ class TrainConfig:
     shuffle: bool = False
     # Host-side batch assembly for batch_size > 1:
     #   "auto"   — use the native C++ prefetching batcher (data/native.py)
-    #              when the extension builds, else plain NumPy slicing;
+    #              when the extension builds, else a NumPy fallback with
+    #              IDENTICAL semantics (drop-tail, xorshift shuffle via
+    #              pipeline.xorshift_permutation) — the same config+seed
+    #              trains bit-identically with or without a toolchain;
     #   "native" — require the native batcher (error if unavailable);
-    #   "off"    — always plain NumPy slicing.
-    # The native path drops the ragged tail batch (fixed-shape steps);
-    # the NumPy path runs the tail at its own shape.
+    #   "off"    — plain NumPy slicing (keep-tail, NumPy PCG shuffle).
     prefetch: str = "auto"
+
+    # Which kernel library executes the FLOPs (SURVEY.md §7 stages 3-4):
+    #   "reference" — path A, jnp/lax ops (XLA-fused; the parity surface);
+    #   "pallas"    — path B, the hand-written Mosaic kernels
+    #                 (ops/pallas.py ≙ the CUDA backend's kernel library,
+    #                 CUDA/layer.cu:80-368). Batched mode only.
+    ops: str = "reference"
 
     def __post_init__(self):
         if self.batch_size == 1 and self.dtype != "float32":
@@ -71,6 +79,14 @@ class TrainConfig:
                 "batch_size=1 is the strict-parity mode and is float32-only "
                 f"(got dtype={self.dtype!r}); use batch_size>1 for bf16 "
                 "throughput"
+            )
+        if self.ops not in ("reference", "pallas"):
+            raise ValueError(f"unknown ops path {self.ops!r}")
+        if self.ops == "pallas" and self.batch_size == 1:
+            raise ValueError(
+                "ops='pallas' is the batched kernel path (its grids tile the "
+                "batch dimension); use batch_size>1, or ops='reference' for "
+                "strict per-sample parity"
             )
 
 

@@ -182,6 +182,13 @@ class Batcher:
         self._labels = np.ascontiguousarray(labels, dtype=np.int32)
         if self._images.shape[0] != self._labels.shape[0]:
             raise ValueError("images/labels count mismatch")
+        if batch_size > self._images.shape[0]:
+            # The ring fill would wrap mid-batch and silently duplicate
+            # samples within a single batch (and reshuffle mid-batch).
+            raise ValueError(
+                f"batch_size {batch_size} exceeds dataset size "
+                f"{self._images.shape[0]}"
+            )
         self.batch_size = batch_size
         self._handle = _lib.pcnn_batcher_create(
             self._images.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
@@ -225,7 +232,9 @@ class Batcher:
         return x, y
 
     def close(self) -> None:
-        if self._handle is not None:
+        # getattr: __del__ runs even when __init__ raised before _handle
+        # was assigned (e.g. the batch_size > n rejection).
+        if getattr(self, "_handle", None) is not None:
             _lib.pcnn_batcher_destroy(self._handle)
             self._handle = None
 

@@ -106,6 +106,55 @@ def epoch_batches(
         yield ds.images[j], ds.labels[j]
 
 
+_U64 = (1 << 64) - 1
+_XORSHIFT_DEFAULT_SEED = 0x9E3779B97F4A7C15
+_XORSHIFT_MULT = 0x2545F4914F6CDD1D
+
+
+def xorshift_permutation(n: int, seed: int) -> np.ndarray:
+    """Bit-identical twin of the native batcher's epoch permutation
+    (native/batcher.cc: XorShift64 + descending Fisher–Yates).
+
+    Exists so `prefetch="auto"` is environment-independent: the NumPy
+    fallback visits samples in EXACTLY the order the C++ ring would, so
+    the same config+seed produces the same trajectory whether or not a
+    toolchain is present. Differentially tested against the native ring
+    in tests/test_native.py.
+    """
+    perm = np.arange(n, dtype=np.int64)
+    s = seed & _U64
+    if s == 0:
+        s = _XORSHIFT_DEFAULT_SEED
+    for i in range(n - 1, 0, -1):
+        s ^= s >> 12
+        s = (s ^ (s << 25)) & _U64
+        s ^= s >> 27
+        j = ((s * _XORSHIFT_MULT) & _U64) % (i + 1)
+        perm[i], perm[j] = perm[j], perm[i]
+    return perm
+
+
+def native_semantics_batches(
+    ds: Dataset,
+    batch_size: int,
+    *,
+    shuffle: bool = False,
+    seed: int = 0,
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """One epoch of batches with the native ring's exact semantics:
+    drop-tail (fixed shapes) and the xorshift Fisher–Yates order. This is
+    the `prefetch="auto"` fallback when the C++ extension can't build."""
+    n = len(ds)
+    idx = (
+        xorshift_permutation(n, seed)
+        if shuffle
+        else np.arange(n, dtype=np.int64)
+    )
+    for i in range(0, n - (n % batch_size), batch_size):
+        j = idx[i : i + batch_size]
+        yield ds.images[j], ds.labels[j]
+
+
 def pad_to_batch(
     images: np.ndarray, labels: np.ndarray, batch_size: int
 ) -> Tuple[np.ndarray, np.ndarray, int]:

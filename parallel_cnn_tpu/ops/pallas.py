@@ -58,8 +58,16 @@ Params = ref_ops.Params
 
 
 def _interpret() -> bool:
-    """Compiled Mosaic on TPU; interpreter everywhere else (CPU tests)."""
-    return jax.default_backend() != "tpu"
+    """Compiled Mosaic on TPU; interpreter everywhere else (CPU tests).
+
+    Uses utils.backend.is_tpu, NOT `jax.default_backend() == "tpu"`: under
+    the axon relay the backend name is "axon" while the hardware is a real
+    TPU chip — the naive check would (and in round 1 did) silently run the
+    interpreter on real hardware.
+    """
+    from parallel_cnn_tpu.utils.backend import is_tpu
+
+    return not is_tpu()
 
 
 def _batch_block(n: int, want: int = 128) -> int:

@@ -88,6 +88,39 @@ def batched_step(
     return apply_grad(params, mean_grads, dt), jnp.mean(errs)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("dt", "compute_dtype"), donate_argnums=(0,)
+)
+def pallas_batched_step(
+    params: Params,
+    x: jax.Array,
+    y: jax.Array,
+    dt: float,
+    compute_dtype: str | None = None,
+) -> Tuple[Params, jax.Array]:
+    """`batched_step` on the Pallas kernel path (ops/pallas.py, path B).
+
+    Same reference numerics contract, but every FLOP-bearing stage runs in
+    a hand-written Mosaic kernel (≙ the CUDA driver wiring its kernels into
+    learn(), CUDA/main.cu:56-163). Differentially tested against
+    `batched_step` in tests/test_train.py.
+    """
+    from parallel_cnn_tpu.ops import pallas as pk
+
+    cdt = jnp.dtype(compute_dtype or "float32")
+    cparams = jax.tree_util.tree_map(lambda p: p.astype(cdt), params)
+    err, mean_grads = pk.batched_value_and_ref_grads(cparams, x.astype(cdt), y)
+    mean_grads = jax.tree_util.tree_map(
+        lambda g: g.astype(jnp.float32), mean_grads
+    )
+    return apply_grad(params, mean_grads, dt), err.astype(jnp.float32)
+
+
+def batched_step_fn(ops_path: str):
+    """The minibatch step for a TrainConfig.ops value."""
+    return pallas_batched_step if ops_path == "pallas" else batched_step
+
+
 @jax.jit
 def classify_batch(params: Params, x: jax.Array) -> jax.Array:
     """≙ classify() (Sequential/Main.cpp:186-200), vectorized: argmax of the

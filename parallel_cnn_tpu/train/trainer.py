@@ -19,6 +19,7 @@ import numpy as np
 from parallel_cnn_tpu.config import Config
 from parallel_cnn_tpu.data import pipeline
 from parallel_cnn_tpu.models import lenet_ref
+from parallel_cnn_tpu.parallel import data_parallel, intra_op, mesh as mesh_lib
 from parallel_cnn_tpu.train import step as step_lib
 from parallel_cnn_tpu.utils.timing import Stopwatch
 
@@ -44,6 +45,62 @@ def _native_batcher_cls(tc):
             raise
         return None
     return native.Batcher
+
+
+def _maybe_mesh(cfg: Config):
+    """Build the training mesh when the config asks for one, else None.
+
+    Opt-in: the default MeshConfig (data=None, model=1) means single-device
+    training — setting either axis (cli --mesh-data/--mesh-model) routes
+    the minibatch path through the mesh (≙ the reference's MPI driver being
+    an actually-launchable program, MPI/Main.cpp:43-53).
+    """
+    mc, tc = cfg.mesh, cfg.train
+    if mc.data is None and mc.model == 1:
+        return None
+    if tc.batch_size == 1:
+        raise ValueError(
+            "mesh training is the minibatch throughput mode; batch_size=1 "
+            "strict parity is inherently sequential and single-device"
+        )
+    if tc.ops == "pallas":
+        raise ValueError("ops='pallas' is single-device; use ops='reference' with a mesh")
+    if tc.dtype != "float32":
+        raise ValueError("mesh training is float32 (bf16 not wired through shard_map yet)")
+    mesh = mesh_lib.make_mesh(mc)
+    n_data, n_model = mesh.shape[mesh_lib.DATA_AXIS], mesh.shape[mesh_lib.MODEL_AXIS]
+    if 6 % n_model:
+        raise ValueError(
+            f"model axis {n_model} must divide the 6 conv filters "
+            "(legal: 1, 2, 3, 6 — parallel/intra_op.py PARAM_SPECS)"
+        )
+    if tc.batch_size % n_data:
+        raise ValueError(
+            f"batch_size {tc.batch_size} must divide evenly over the "
+            f"data axis ({n_data})"
+        )
+    return mesh
+
+
+def _fixed_shape_batches(train, tc, epoch_seed, batcher_cls, steps_per_epoch):
+    """One epoch of fixed-shape (drop-tail) batches, native ring when built,
+    bit-identical NumPy twin otherwise ("off" keeps PCG order)."""
+    if batcher_cls is not None and steps_per_epoch > 0:
+        with batcher_cls(
+            train.images, train.labels, tc.batch_size,
+            seed=epoch_seed, shuffle=tc.shuffle,
+        ) as batcher:
+            for _ in range(steps_per_epoch):
+                yield next(batcher)
+    elif tc.prefetch == "auto":
+        yield from pipeline.native_semantics_batches(
+            train, tc.batch_size, shuffle=tc.shuffle, seed=epoch_seed
+        )
+    else:
+        yield from pipeline.epoch_batches(
+            train, tc.batch_size, shuffle=tc.shuffle, seed=epoch_seed,
+            drop_remainder=True,
+        )
 
 
 def learn(
@@ -84,6 +141,32 @@ def learn(
 
     batcher_cls = _native_batcher_cls(tc)
     steps_per_epoch = len(train) // tc.batch_size if tc.batch_size > 1 else 0
+    # Which kernel library executes the minibatch step (cfg.train.ops):
+    # path A (jnp/lax) or path B (Pallas/Mosaic).
+    batched_step = step_lib.batched_step_fn(tc.ops)
+
+    # Mesh routing (cfg.mesh, opt-in): DP when model axis is 1, hybrid
+    # DP×intra-op otherwise. Params move into their mesh layout once; each
+    # batch is shard-put over the data axis.
+    mesh = _maybe_mesh(cfg)
+    mesh_step = None
+    if mesh is not None:
+        if steps_per_epoch == 0:
+            raise ValueError(
+                f"batch_size {tc.batch_size} exceeds dataset size {len(train)}"
+            )
+        if mesh.shape[mesh_lib.MODEL_AXIS] > 1:
+            params = intra_op.shard_params(mesh, params)
+            mesh_step = intra_op.make_2d_step(
+                mesh, dt=tc.dt, global_batch=tc.batch_size
+            )
+        else:
+            params = mesh_lib.replicate(mesh, params)
+            mesh_step = data_parallel.make_dp_step(
+                mesh, dt=tc.dt, global_batch=tc.batch_size
+            )
+        if verbose:
+            print(f"mesh: {dict(mesh.shape)}")
 
     for epoch in range(tc.epochs):
         # Per-epoch derived seed: every path reshuffles each epoch (and all
@@ -102,28 +185,33 @@ def learn(
                 else:
                     ex, ey = images, labels
                 params, err = step_lib.scan_epoch(params, ex, ey, tc.dt)
-            elif batcher_cls is not None and steps_per_epoch > 0:
-                # Native C++ prefetch ring: batch assembly overlaps the
-                # device step; fixed shapes, tail dropped, cursor reset at
-                # the epoch boundary (fresh Batcher per epoch).
+            elif steps_per_epoch > 0 and (
+                mesh_step is not None
+                or batcher_cls is not None
+                or tc.prefetch == "auto"
+            ):
+                # Fixed-shape (drop-tail) minibatch epoch: native prefetch
+                # ring when built, its bit-identical NumPy twin otherwise
+                # ("auto" reproducibility contract). Mesh mode shards each
+                # batch over the data axis.
                 errs = []
-                with batcher_cls(
-                    train.images,
-                    train.labels,
-                    tc.batch_size,
-                    seed=epoch_seed,
-                    shuffle=tc.shuffle,
-                ) as batcher:
-                    for _ in range(steps_per_epoch):
-                        bx, by = next(batcher)
-                        params, e = step_lib.batched_step(
+                for bx, by in _fixed_shape_batches(
+                    train, tc, epoch_seed, batcher_cls, steps_per_epoch
+                ):
+                    if mesh_step is not None:
+                        xs_, ys_ = mesh_lib.shard_batch(
+                            mesh, (jnp.asarray(bx), jnp.asarray(by))
+                        )
+                        params, e = mesh_step(params, xs_, ys_)
+                    else:
+                        params, e = batched_step(
                             params,
                             jnp.asarray(bx),
                             jnp.asarray(by),
                             tc.dt,
                             compute_dtype=tc.dtype,
                         )
-                        errs.append(e)
+                    errs.append(e)
                 err = jnp.mean(jnp.stack(errs))
             else:
                 errs, weights = [], []
@@ -136,7 +224,7 @@ def learn(
                     seed=epoch_seed,
                     drop_remainder=False,
                 ):
-                    params, e = step_lib.batched_step(
+                    params, e = batched_step(
                         params,
                         jnp.asarray(bx),
                         jnp.asarray(by),

@@ -1,0 +1,39 @@
+"""Backend identification helpers.
+
+The one subtlety worth a module: TPU hardware does not always present as
+platform "tpu". Under the ambient `axon` relay (a PJRT plugin tunneling to
+a real chip) the platform/backend name is "axon" — so naive
+`jax.default_backend() == "tpu"` checks silently mis-detect real TPU
+hardware (round 1 shipped Pallas kernels that interpreted on the real chip
+for exactly this reason). Detection here keys on the device_kind too.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+
+# Platform names known to front a real TPU.
+_TPU_PLATFORMS = frozenset({"tpu", "axon"})
+
+
+def is_tpu(devices: Optional[Sequence] = None) -> bool:
+    """True iff the (default) backend executes on TPU hardware, including
+    via relay plugins whose platform name is not literally "tpu"."""
+    ds = list(devices) if devices is not None else jax.devices()
+    if not ds:
+        return False
+    d = ds[0]
+    platform = (getattr(d, "platform", "") or "").lower()
+    kind = (getattr(d, "device_kind", "") or "").lower()
+    return platform in _TPU_PLATFORMS or "tpu" in kind
+
+
+def canonical_platform(devices: Optional[Sequence] = None) -> str:
+    """"tpu" for any TPU-backed platform (native or relayed), else the raw
+    platform name ("cpu", "gpu", ...). This is the label benchmarks report."""
+    if is_tpu(devices):
+        return "tpu"
+    ds = list(devices) if devices is not None else jax.devices()
+    return (getattr(ds[0], "platform", "") or "unknown").lower() if ds else "unknown"

@@ -134,3 +134,40 @@ def test_cli_end_to_end_with_checkpoint_resume(tmp_path):
     assert r2.returncode == 0, r2.stderr
     assert "resumed from" in r2.stdout
     assert r2.stdout.count("error:") == 1
+
+
+@pytest.mark.slow
+def test_cli_mesh_training(tmp_path):
+    """--mesh-data/--mesh-model drive learn() over the 8-device CPU mesh
+    from a real subprocess (≙ mpirun launching MPI/Main.cpp:43-53) and
+    match the single-device run's epoch errors exactly."""
+    base = [
+        "--loader", "synthetic",
+        "--synthetic-train-count", "512",
+        "--synthetic-test-count", "128",
+        "--batch-size", "64",
+        "--epochs", "1",
+        "--prefetch", "off",
+    ]
+    env = {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+    single = _run_cli(base, env_extra=env)
+    assert single.returncode == 0, single.stderr
+    meshed = _run_cli(base + ["--mesh-data", "4", "--mesh-model", "2"],
+                      env_extra=env)
+    assert meshed.returncode == 0, meshed.stderr
+    assert "mesh: {'data': 4, 'model': 2}" in meshed.stdout
+
+    def errors(out):
+        return [float(l.split(",")[0].split()[1]) for l in out.splitlines()
+                if l.startswith("error:")]
+
+    def rate(out):
+        return [float(l.split()[-1].rstrip("%")) for l in out.splitlines()
+                if l.startswith("Error Rate:")]
+
+    # Different reduction order (per-shard sums + psum vs one jnp.mean):
+    # values agree to fp tolerance, not bit-exactly.
+    np.testing.assert_allclose(errors(meshed.stdout), errors(single.stdout),
+                               rtol=1e-5)
+    np.testing.assert_allclose(rate(meshed.stdout), rate(single.stdout),
+                               atol=0.5)

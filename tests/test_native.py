@@ -101,6 +101,69 @@ def test_batcher_no_shuffle_replays_file_order(idx_files):
     np.testing.assert_array_equal(got, labels)
 
 
+def test_batcher_rejects_batch_larger_than_dataset(idx_files):
+    """batch_size > n would wrap the cursor mid-batch and silently
+    duplicate samples (ADVICE r1); both layers must reject it."""
+    ip, lp = idx_files
+    imgs, labels = native.load_pair(ip, lp)
+    with pytest.raises(ValueError, match="exceeds dataset size"):
+        native.Batcher(imgs, labels, imgs.shape[0] + 1)
+    # the C ABI itself also refuses (nullptr), independent of the wrapper
+    import ctypes
+
+    assert (
+        native._lib.pcnn_batcher_create(
+            imgs.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            imgs.shape[0],
+            imgs.shape[0] + 1,
+            2,
+            1,
+            0,
+        )
+        is None
+    )
+
+
+def test_numpy_twin_matches_native_shuffle_order(idx_files):
+    """pipeline.xorshift_permutation must replay the C++ ring's epoch order
+    bit-identically — the prefetch="auto" reproducibility contract."""
+    from parallel_cnn_tpu.data import pipeline
+
+    ip, lp = idx_files
+    imgs, labels = native.load_pair(ip, lp)
+    n, bs = imgs.shape[0], 8
+    for seed in (0, 7, 1 << 60):
+        perm = pipeline.xorshift_permutation(n, seed)
+        with native.Batcher(imgs, labels, bs, seed=seed, shuffle=True) as it:
+            for step, (x, y) in enumerate(itertools.islice(it, n // bs)):
+                idx = perm[step * bs : (step + 1) * bs]
+                np.testing.assert_array_equal(y, labels[idx])
+                np.testing.assert_array_equal(x, imgs[idx])
+
+
+def test_native_semantics_batches_matches_batcher(idx_files):
+    """The full NumPy fallback iterator ≡ the native ring (drop-tail +
+    order), so trainer trajectories are toolchain-independent."""
+    from parallel_cnn_tpu.data import pipeline
+
+    ip, lp = idx_files
+    imgs, labels = native.load_pair(ip, lp)
+    ds = pipeline.Dataset(imgs, labels)
+    bs = 7  # ragged: 64 % 7 != 0 exercises drop-tail on both sides
+    steps = len(ds) // bs
+    fallback = list(
+        pipeline.native_semantics_batches(ds, bs, shuffle=True, seed=21)
+    )
+    assert len(fallback) == steps
+    with native.Batcher(imgs, labels, bs, seed=21, shuffle=True) as it:
+        for (fx, fy), (nx, ny) in zip(
+            fallback, itertools.islice(it, steps), strict=True
+        ):
+            np.testing.assert_array_equal(fx, nx)
+            np.testing.assert_array_equal(fy, ny)
+
+
 def test_batcher_views_stable_until_next(idx_files):
     """copy=False zero-copy views must not be overwritten while the consumer
     holds them (deferred release), even with a deep prefetch ring."""

@@ -106,6 +106,108 @@ def test_bf16_compute_dtype():
         )
 
 
+def test_pallas_step_matches_reference_step():
+    """path B as a product step: pallas_batched_step must track
+    batched_step (same params, same batch) to fp tolerance — the driver-
+    level differential check behind the --ops flag."""
+    params = lenet_ref.init(jax.random.key(2))
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.uniform(0, 1, (16, 28, 28)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 10, (16,)).astype(np.int32))
+
+    pa, ea = step_lib.batched_step(
+        jax.tree_util.tree_map(jnp.array, params), x, y, 0.1
+    )
+    pb, eb = step_lib.pallas_batched_step(
+        jax.tree_util.tree_map(jnp.array, params), x, y, 0.1
+    )
+    np.testing.assert_allclose(float(ea), float(eb), rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(pa),
+        jax.tree_util.tree_leaves(pb),
+        strict=True,
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_learn_with_pallas_ops():
+    """End-to-end learn() on the Pallas path (--ops pallas): same epoch
+    errors as the reference path to fp tolerance."""
+    xs, ys = small_data(64, seed=9)
+    ds = Dataset(np.asarray(xs), np.asarray(ys))
+
+    def run(ops):
+        cfg = Config(
+            train=TrainConfig(
+                epochs=2, batch_size=16, ops=ops, prefetch="off"
+            )
+        )
+        return trainer.learn(cfg, ds, verbose=False)
+
+    ref, pal = run("reference"), run("pallas")
+    np.testing.assert_allclose(
+        ref.epoch_errors, pal.epoch_errors, rtol=1e-5
+    )
+
+
+def test_learn_on_mesh_matches_single_device():
+    """cfg.mesh routes learn() through the DP / hybrid mesh paths; the
+    epoch errors must match single-device minibatch training (same batch
+    order) to fp tolerance — VERDICT r1 #5's CLI/trainer mesh wiring."""
+    from parallel_cnn_tpu.config import MeshConfig
+
+    xs, ys = small_data(64, seed=13)
+    ds = Dataset(np.asarray(xs), np.asarray(ys))
+
+    def run(mesh):
+        cfg = Config(
+            train=TrainConfig(
+                epochs=2, batch_size=16, shuffle=True, prefetch="off"
+            ),
+            mesh=mesh,
+        )
+        return trainer.learn(cfg, ds, verbose=False)
+
+    single = run(MeshConfig())                      # no mesh
+    dp = run(MeshConfig(data=4, model=1))           # pure DP
+    hybrid = run(MeshConfig(data=4, model=2))       # DP × intra-op
+    np.testing.assert_allclose(single.epoch_errors, dp.epoch_errors, rtol=1e-5)
+    np.testing.assert_allclose(single.epoch_errors, hybrid.epoch_errors, rtol=1e-5)
+    # trained params usable downstream (sharded arrays feed test() as-is)
+    rate = trainer.test(hybrid.params, ds, verbose=False)
+    assert 0.0 <= rate <= 100.0
+
+
+def test_mesh_config_validation():
+    from parallel_cnn_tpu.config import MeshConfig
+
+    import pytest
+
+    xs, ys = small_data(8)
+    ds = Dataset(np.asarray(xs), np.asarray(ys))
+    with pytest.raises(ValueError, match="single-device"):
+        trainer.learn(
+            Config(train=TrainConfig(batch_size=1),
+                   mesh=MeshConfig(data=2)), ds, verbose=False)
+    with pytest.raises(ValueError, match="divide evenly"):
+        trainer.learn(
+            Config(train=TrainConfig(batch_size=3),
+                   mesh=MeshConfig(data=2)), ds, verbose=False)
+    with pytest.raises(ValueError, match="6 conv filters"):
+        trainer.learn(
+            Config(train=TrainConfig(batch_size=4),
+                   mesh=MeshConfig(data=2, model=4)), ds, verbose=False)
+
+
+def test_pallas_rejected_in_parity_mode():
+    import pytest
+
+    from parallel_cnn_tpu.config import TrainConfig
+
+    with pytest.raises(ValueError, match="batched kernel path"):
+        TrainConfig(batch_size=1, ops="pallas")
+
+
 def test_bf16_rejected_in_parity_mode():
     """The constraint fails fast at config construction, before any data
     loading or device work."""
