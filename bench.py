@@ -186,9 +186,11 @@ def main() -> None:
         except Exception as e:  # labeled, not fatal
             pallas_img_per_sec = f"error: {type(e).__name__}: {e}"[:200]
 
+    # MFU on TPU by default (v5e peak), or on any platform when the user
+    # supplies their chip's peak via PCNN_PEAK_FLOPS.
     mfu = (
         round(FLOPS_PER_IMAGE * img_per_sec / TPU_PEAK_FLOPS, 8)
-        if platform == "tpu"
+        if platform == "tpu" or "PCNN_PEAK_FLOPS" in os.environ
         else None
     )
     print(

@@ -199,9 +199,10 @@ def learn(
                     train, tc, epoch_seed, batcher_cls, steps_per_epoch
                 ):
                     if mesh_step is not None:
-                        xs_, ys_ = mesh_lib.shard_batch(
-                            mesh, (jnp.asarray(bx), jnp.asarray(by))
-                        )
+                        # Shard straight from host NumPy: wrapping in
+                        # jnp.asarray first would commit the full batch to
+                        # device 0 and pay a second transfer to reshard.
+                        xs_, ys_ = mesh_lib.shard_batch(mesh, (bx, by))
                         params, e = mesh_step(params, xs_, ys_)
                     else:
                         params, e = batched_step(

@@ -19,6 +19,7 @@ is a lax.scan over microbatches inside the same jitted step.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Any, Callable, Optional, Tuple
 
@@ -156,6 +157,30 @@ def make_eval_step(model: Module) -> Callable:
     return eval_step
 
 
+def evaluate(
+    model: Module,
+    state: ZooState,
+    images,
+    labels,
+    batch_size: int = 256,
+    eval_step: Optional[Callable] = None,
+) -> float:
+    """Accuracy (%) over an in-memory eval split, in on-device batches.
+
+    Pass a prebuilt ``eval_step`` when calling in a loop — each
+    make_eval_step closure is its own jit cache key, so rebuilding per
+    call would recompile the eval graph every epoch.
+    """
+    ev = eval_step if eval_step is not None else make_eval_step(model)
+    n = images.shape[0]
+    correct = 0
+    for i in range(0, n, batch_size):
+        x = jnp.asarray(images[i : i + batch_size])
+        y = jnp.asarray(labels[i : i + batch_size])
+        correct += int(ev(state.params, state.model_state, x, y))
+    return correct / n * 100.0
+
+
 def train(
     model: Module,
     images,
@@ -171,14 +196,49 @@ def train(
     mesh: Optional[Mesh] = None,
     seed: int = 0,
     verbose: bool = True,
+    eval_data: Optional[Tuple[Any, Any]] = None,
+    eval_batch_size: int = 256,
+    checkpoint_dir: Optional[str] = None,
+    resume: bool = False,
+    metrics=None,
 ):
-    """Minimal epoch driver for zoo models on an in-memory dataset.
+    """Epoch driver for zoo models on an in-memory dataset.
+
+    Production surface (fills the SURVEY.md §5 checkpoint gap at zoo
+    scale — the reference's weights "live only in process memory"):
+
+    - ``checkpoint_dir``: after every epoch, atomically persist the FULL
+      ``ZooState`` (params + optimizer momentum + BatchNorm running stats)
+      via train/checkpoint.py; ``resume=True`` restarts from the latest
+      checkpoint and — because epoch shuffles derive from ``seed + epoch``
+      — continues on the exact trajectory of an uninterrupted run
+      (kill-and-resume tested in tests/test_zoo.py).
+    - ``eval_data=(images, labels)``: in-loop accuracy after each epoch.
+    - ``metrics``: a utils.metrics.MetricsLogger; per-epoch records.
 
     Returns (ZooState, list of per-epoch mean losses).
     """
     optimizer = make_optimizer(lr, momentum, weight_decay)
     state = init_state(model, jax.random.key(seed), in_shape, optimizer)
     step = make_train_step(model, optimizer, accum_steps, mesh)
+    ev_step = make_eval_step(model) if eval_data is not None else None
+
+    start_epoch = 0
+    losses: list = []
+    accs: list = []
+    if checkpoint_dir and resume:
+        from parallel_cnn_tpu.train import checkpoint
+
+        path = checkpoint.latest(checkpoint_dir)
+        if path:
+            # `state` is the restore template: full-state structure
+            # (params + opt_state + BN stats) validated leaf-for-leaf.
+            state, tstate = checkpoint.restore(path, state)
+            start_epoch = tstate.epoch
+            losses = list(tstate.epoch_errors)
+            accs = list(tstate.extra.get("epoch_accs", []))
+            if verbose:
+                print(f"resumed from {path} (epoch {start_epoch})")
 
     n = images.shape[0]
     steps = n // batch_size
@@ -188,8 +248,7 @@ def train(
         )
     images = jnp.asarray(images)
     labels = jnp.asarray(labels)
-    losses = []
-    for epoch in range(epochs):
+    for epoch in range(start_epoch, epochs):
         perm = jax.random.permutation(jax.random.key(seed + epoch), n)
         t0 = time.perf_counter()
         # Device-side loss accumulation: one host readback per epoch, so
@@ -201,9 +260,34 @@ def train(
             state, loss = step(state, images[idx], labels[idx])
             epoch_loss = epoch_loss + loss
         losses.append(float(epoch_loss) / max(steps, 1))
+        seconds = time.perf_counter() - t0
+        if eval_data is not None:
+            accs.append(
+                evaluate(model, state, *eval_data,
+                         batch_size=eval_batch_size, eval_step=ev_step)
+            )
+        if metrics is not None:
+            rec = dict(event="zoo_epoch", epoch=epoch + 1,
+                       loss=losses[-1], seconds=seconds)
+            if eval_data is not None:
+                rec["accuracy"] = accs[-1]
+            metrics.record(**rec)
+        if checkpoint_dir:
+            from parallel_cnn_tpu.train import checkpoint
+
+            checkpoint.save(
+                os.path.join(checkpoint_dir, f"ckpt_{epoch + 1}.npz"),
+                state,
+                checkpoint.TrainState(
+                    epoch=epoch + 1,
+                    epoch_errors=list(losses),
+                    extra={"epoch_accs": list(accs)},
+                ),
+            )
         if verbose:
+            acc_txt = f", acc {accs[-1]:.2f}%" if eval_data is not None else ""
             print(
-                f"epoch {epoch + 1}: loss {losses[-1]:.4f} "
-                f"({time.perf_counter() - t0:.2f}s)"
+                f"epoch {epoch + 1}: loss {losses[-1]:.4f}{acc_txt} "
+                f"({seconds:.2f}s)"
             )
     return state, losses

