@@ -163,14 +163,30 @@ def main() -> None:
 
         return epoch
 
-    def ref_batch_grads(p, x, y):
-        errs, grads = jax.vmap(ops.value_and_ref_grads, in_axes=(None, 0, 0))(p, x, y)
-        return jnp.mean(errs), jax.tree_util.tree_map(
-            lambda g: jnp.mean(g, axis=0), grads
-        )
+    def make_batch_grads(dtype):
+        """Minibatch reference grads at a compute dtype — the same
+        mixed-precision recipe as train/step.py batched_step (f32 master
+        weights; bf16 casts are traced no-ops when dtype is f32)."""
+        cdt = jnp.dtype(dtype)
+
+        def batch_grads(p, x, y):
+            cp = jax.tree_util.tree_map(lambda v: v.astype(cdt), p)
+            errs, grads = jax.vmap(
+                ops.value_and_ref_grads, in_axes=(None, 0, 0)
+            )(cp, x.astype(cdt), y)
+            return (
+                jnp.mean(errs).astype(jnp.float32),
+                jax.tree_util.tree_map(
+                    lambda g: jnp.mean(g.astype(jnp.float32), axis=0), grads
+                ),
+            )
+
+        return batch_grads
 
     n_images = STEPS_PER_EPOCH * BATCH * TIMED_REPEATS
-    compute = _time_epochs(make_epoch(ref_batch_grads), params, images, labels)
+    compute = _time_epochs(
+        make_epoch(make_batch_grads("float32")), params, images, labels
+    )
     img_per_sec = n_images / compute
 
     # Path B: the same epoch on the hand-written Pallas kernels — compiled
@@ -185,6 +201,19 @@ def main() -> None:
             pallas_img_per_sec = round(n_images / pallas_compute, 1)
         except Exception as e:  # labeled, not fatal
             pallas_img_per_sec = f"error: {type(e).__name__}: {e}"[:200]
+
+    # bf16 throughput mode (train/step.py batched_step compute_dtype):
+    # f32 master weights, bf16 compute on the MXU — the documented
+    # trajectory-deviating mode, reported alongside the f32 headline.
+    bf16_img_per_sec = None
+    if platform == "tpu" or os.environ.get("PCNN_BENCH_BF16"):
+        try:
+            bf16_compute = _time_epochs(
+                make_epoch(make_batch_grads("bfloat16")), params, images, labels
+            )
+            bf16_img_per_sec = round(n_images / bf16_compute, 1)
+        except Exception as e:
+            bf16_img_per_sec = f"error: {type(e).__name__}: {e}"[:200]
 
     # MFU on TPU by default (v5e peak), or on any platform when the user
     # supplies their chip's peak via PCNN_PEAK_FLOPS.
@@ -204,6 +233,7 @@ def main() -> None:
                 "mfu": mfu,
                 "flops_per_image": FLOPS_PER_IMAGE,
                 "pallas_img_per_sec": pallas_img_per_sec,
+                "bf16_img_per_sec": bf16_img_per_sec,
             }
         )
     )

@@ -254,6 +254,73 @@ def bench_dp_scaling(quick: bool) -> List[Row]:
     return rows
 
 
+def bench_northstar(quick: bool) -> List[Row]:
+    """BASELINE.json's north-star metric: epochs-to-98% test accuracy for
+    the MNIST LeNet (throughput mode, shuffled minibatch SGD), plus the
+    final accuracy. Runs on real MNIST when the idx image files exist;
+    the reference snapshot ships labels only (SURVEY.md B15), so the
+    deterministic synthetic stand-in is the default — the row name says
+    which. (No published reference value exists; accuracy was never
+    reported numerically, BASELINE.md.)"""
+    from parallel_cnn_tpu.config import Config, DataConfig, TrainConfig
+    from parallel_cnn_tpu.data import pipeline
+    from parallel_cnn_tpu.train import trainer
+
+    n_train, n_test = (10_000, 2_000) if quick else (60_000, 10_000)
+    data_cfg = DataConfig(
+        synthetic_train_count=n_train, synthetic_test_count=n_test
+    )
+    train_ds, test_ds = pipeline.load_train_test(data_cfg)
+    real = os.path.exists(data_cfg.train_images)
+    tag = "mnist" if real else "synthetic_mnist"
+    # synthetic_* counts don't bound real idx files — cap explicitly so
+    # --quick stays quick when the full dataset is present.
+    train_ds = pipeline.Dataset(
+        train_ds.images[:n_train], train_ds.labels[:n_train]
+    )
+    test_ds = pipeline.Dataset(
+        test_ds.images[:n_test], test_ds.labels[:n_test]
+    )
+
+    # Two trajectories: strict parity (the reference's per-sample SGD —
+    # "parity with Sequential baseline loss curve") and throughput mode
+    # (minibatch; dt re-tuned, since mean-grads at the per-sample dt=0.1
+    # undertrain and large dt saturates the sigmoids — swept empirically).
+    modes = [
+        ("parity", TrainConfig(epochs=1, batch_size=1), 4),
+        ("batched", TrainConfig(epochs=1, batch_size=32, dt=0.4,
+                                shuffle=True, prefetch="off"), 10),
+    ]
+    rows = []
+    for mode, tc0, max_epochs in modes:
+        params = None
+        epochs_to_98 = None
+        acc = 0.0
+        t0 = time.perf_counter()
+        for epoch in range(1, max_epochs + 1):
+            cfg = Config(data=data_cfg, train=tc0)
+            res = trainer.learn(cfg, train_ds, params=params, verbose=False,
+                                epoch_offset=epoch - 1)
+            params = res.params
+            acc = 100.0 - trainer.test(params, test_ds, verbose=False)
+            if acc >= 98.0:
+                epochs_to_98 = epoch
+                break
+        wall = time.perf_counter() - t0
+        rows.append(
+            Row(f"northstar_epochs_to_98pct_{mode}_{tag}",
+                float(epochs_to_98 if epochs_to_98 is not None else -1),
+                "epochs", None,
+                f"acc {acc:.2f}% after {wall:.1f}s "
+                "(reference never reports accuracy)").finish()
+        )
+        rows.append(
+            Row(f"northstar_final_accuracy_{mode}_{tag}", round(acc, 2),
+                "%", None, "98% target (BASELINE.json)").finish()
+        )
+    return rows
+
+
 def bench_zoo(quick: bool) -> List[Row]:
     """Model-zoo step throughput (BASELINE.json configs #3-#4)."""
     from parallel_cnn_tpu.data import synthetic
@@ -308,7 +375,8 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--suite",
         default="all",
-        choices=["all", "lenet", "phases", "dp", "zoo", "parity", "ops"],
+        choices=["all", "lenet", "phases", "dp", "zoo", "parity", "ops",
+                 "northstar"],
     )
     args = ap.parse_args(argv)
 
@@ -319,6 +387,7 @@ def main(argv=None) -> int:
         "ops": bench_ops_paths,
         "dp": bench_dp_scaling,
         "zoo": bench_zoo,
+        "northstar": bench_northstar,
     }
     picked = suites.values() if args.suite == "all" else [suites[args.suite]]
 

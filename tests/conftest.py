@@ -27,6 +27,14 @@ jax.config.update("jax_default_matmul_precision", "highest")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# The genuine MNIST label artifacts shipped in the reference snapshot
+# (format contract at Sequential/mnist.h:79-160) — shared by the NumPy- and
+# native-parser tests so the paths live in exactly one place.
+REFERENCE_LABELS = [
+    ("/root/reference/data/train-labels.idx1-ubyte", 60_000),
+    ("/root/reference/data/t10k-labels.idx1-ubyte", 10_000),
+]
+
 
 @pytest.fixture(scope="session")
 def rng():

@@ -204,10 +204,17 @@ def test_two_process_distributed_smoke(tmp_path):
             )
         )
     outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=240)
-        assert p.returncode == 0, err
-        outs.append(out)
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            assert p.returncode == 0, err
+            outs.append(out)
+    finally:
+        # Never orphan a rank: a hung/failed peer would otherwise sit in
+        # jax.distributed.initialize forever, pinning a CPU across re-runs.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     for rank, out in enumerate(outs):
         line = [l for l in out.splitlines() if l.startswith("RESULT")][0]
         _, nproc, pid, gathered = line.split()

@@ -81,10 +81,7 @@ def test_epoch_batches_and_padding(rng):
     assert x.shape[0] == 4 and valid == 2
 
 
-REFERENCE_LABELS = [
-    ("/root/reference/data/train-labels.idx1-ubyte", 60_000),
-    ("/root/reference/data/t10k-labels.idx1-ubyte", 10_000),
-]
+from conftest import REFERENCE_LABELS
 
 
 @pytest.mark.parametrize("path,count", REFERENCE_LABELS)

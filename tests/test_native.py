@@ -180,13 +180,10 @@ def test_batcher_views_stable_until_next(idx_files):
         np.testing.assert_array_equal(y, snap_y)
 
 
-@pytest.mark.parametrize(
-    "path,count",
-    [
-        ("/root/reference/data/train-labels.idx1-ubyte", 60_000),
-        ("/root/reference/data/t10k-labels.idx1-ubyte", 10_000),
-    ],
-)
+from conftest import REFERENCE_LABELS
+
+
+@pytest.mark.parametrize("path,count", REFERENCE_LABELS)
 def test_native_parses_reference_real_label_files(path, count):
     """Native parser against the genuine reference artifacts; must agree
     byte-for-byte with the NumPy parser (differential, SURVEY.md §4)."""

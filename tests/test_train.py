@@ -51,19 +51,21 @@ def test_batched_step_reduces_error():
 
 
 def test_learn_and_test_integration():
-    """End-to-end: learn() on a small synthetic set must beat chance by a
-    wide margin (accuracy-as-test, ≙ Sequential/Main.cpp:202-214)."""
+    """End-to-end convergence-as-test (≙ Sequential/Main.cpp:202-214):
+    learn() must actually train to high accuracy, not merely beat chance —
+    the ≥95% bar backs the BASELINE.json 98% north star at test scale."""
     cfg = Config(
-        data=DataConfig(loader="synthetic", synthetic_train_count=2000,
+        data=DataConfig(loader="synthetic", synthetic_train_count=3000,
                         synthetic_test_count=500),
-        train=TrainConfig(epochs=1, batch_size=1),
+        train=TrainConfig(epochs=2, batch_size=1),
     )
-    train_imgs, train_labels = make_dataset(2000, seed=11)
+    train_imgs, train_labels = make_dataset(3000, seed=11)
     test_imgs, test_labels = make_dataset(500, seed=12)
     res = trainer.learn(cfg, Dataset(train_imgs, train_labels), verbose=False)
     assert len(res.epoch_errors) >= 1
+    assert res.epoch_errors[-1] < res.epoch_errors[0]
     rate = trainer.test(res.params, Dataset(test_imgs, test_labels), verbose=False)
-    assert rate < 50.0  # chance is 90%
+    assert rate < 5.0  # ≥95% accuracy; chance is 10%
 
 
 def test_threshold_early_stop():
