@@ -50,9 +50,19 @@ MACS_FWD = 6 * 24 * 24 * 25 + 216 * 16 + 10 * 216
 MACS_BWD = 10 * 216 + 10 * 216 + 216 * 16 + 216 * 16 + 6 * 25 * 576
 FLOPS_PER_IMAGE = 2 * (MACS_FWD + MACS_BWD)
 
-# Chip peak FLOP/s for the MFU denominator. Default: TPU v5e bf16 peak
-# (197 TFLOP/s); override with PCNN_PEAK_FLOPS for other chips.
-TPU_PEAK_FLOPS = float(os.environ.get("PCNN_PEAK_FLOPS", 197e12))
+# Chip peak FLOP/s for the MFU denominator, matched to the COMPUTE dtype
+# (round-2 advisor finding: quoting an fp32 run against the bf16 peak
+# understates fp32 MFU ~2×). Defaults: TPU v5e — 197 TFLOP/s bf16,
+# 98.5 TFLOP/s fp32. PCNN_PEAK_FLOPS overrides both (single-peak chips).
+_PEAK_OVERRIDE = os.environ.get("PCNN_PEAK_FLOPS")
+TPU_PEAK_BF16 = float(_PEAK_OVERRIDE or os.environ.get("PCNN_PEAK_FLOPS_BF16", 197e12))
+TPU_PEAK_F32 = float(_PEAK_OVERRIDE or os.environ.get("PCNN_PEAK_FLOPS_F32", 98.5e12))
+
+# ResNet-18 (cifar_stem) analytic training FLOPs per image: forward conv/fc
+# MACs summed over the graph (stem 3·3·3·64·32² = 1.77M; stage1 4×3·3·64²·32²;
+# stages 2-4 each 134.2M incl. downsample 1×1; fc 512·10) = 555,422,720 MACs,
+# ×2 FLOP/MAC ×3 for fwd+bwd (bwd ≈ 2× fwd, the standard accounting).
+RESNET18_TRAIN_FLOPS_PER_IMAGE = 2 * 3 * 555_422_720
 
 
 def _resolve_platform() -> str:
@@ -189,16 +199,37 @@ def main() -> None:
     )
     img_per_sec = n_images / compute
 
-    # Path B: the same epoch on the hand-written Pallas kernels — compiled
+    # Path B: the same epoch on the FUSED Pallas megakernel — compiled
     # Mosaic when platform == "tpu" (ops/pallas.py:_interpret). Never allowed
     # to take down the headline number.
     pallas_img_per_sec = None
+    pallas_max_abs_diff = None
     if platform == "tpu" or os.environ.get("PCNN_BENCH_PALLAS"):
         try:
             pallas_compute = _time_epochs(
                 make_epoch(pk.batched_value_and_ref_grads), params, images, labels
             )
             pallas_img_per_sec = round(n_images / pallas_compute, 1)
+            # On-chip A-vs-B grad parity on one batch (kernel_authoring.md
+            # rule 5: interpret-mode tests can't catch Mosaic lowering gaps
+            # — this line is the compiled-numerics evidence).
+            ba = make_batch_grads("float32")
+            _, grads_a = jax.jit(ba)(params, images[0], labels[0])
+            _, grads_b = jax.jit(pk.batched_value_and_ref_grads)(
+                params, images[0], labels[0]
+            )
+            pallas_max_abs_diff = float(
+                jax.tree_util.tree_reduce(
+                    jnp.maximum,
+                    jax.tree_util.tree_map(
+                        lambda a, b: jnp.max(jnp.abs(a - b)), grads_a, grads_b
+                    ),
+                )
+            )
+            if pallas_max_abs_diff > 0.05:  # labeled, not fatal
+                pallas_img_per_sec = (
+                    f"parity-failure: max_abs_diff {pallas_max_abs_diff:.3e}"
+                )
         except Exception as e:  # labeled, not fatal
             pallas_img_per_sec = f"error: {type(e).__name__}: {e}"[:200]
 
@@ -215,11 +246,26 @@ def main() -> None:
         except Exception as e:
             bf16_img_per_sec = f"error: {type(e).__name__}: {e}"[:200]
 
-    # MFU on TPU by default (v5e peak), or on any platform when the user
-    # supplies their chip's peak via PCNN_PEAK_FLOPS.
+    # The MXU-saturation row (VERDICT r2 next #2): ResNet-18 (cifar_stem)
+    # bf16 training throughput + analytic-FLOPs MFU at batch 512 — LeNet's
+    # 379-kFLOP graph can't exercise the MXU; this is the number a TPU
+    # framework's ceiling is judged on.
+    zoo_img_per_sec = None
+    zoo_mfu = None
+    if platform == "tpu" or os.environ.get("PCNN_BENCH_ZOO"):
+        try:
+            zoo_img_per_sec, zoo_mfu = _bench_resnet18()
+        except Exception as e:  # labeled, not fatal
+            zoo_img_per_sec = f"error: {type(e).__name__}: {e}"[:200]
+
+    # MFU on TPU by default (v5e peaks, dtype-matched), or on any platform
+    # when the user supplies their chip's peak via PCNN_PEAK_FLOPS*.
+    any_peak_supplied = _PEAK_OVERRIDE or any(
+        k in os.environ for k in ("PCNN_PEAK_FLOPS_F32", "PCNN_PEAK_FLOPS_BF16")
+    )
     mfu = (
-        round(FLOPS_PER_IMAGE * img_per_sec / TPU_PEAK_FLOPS, 8)
-        if platform == "tpu" or "PCNN_PEAK_FLOPS" in os.environ
+        round(FLOPS_PER_IMAGE * img_per_sec / TPU_PEAK_F32, 8)
+        if platform == "tpu" or any_peak_supplied
         else None
     )
     print(
@@ -233,9 +279,53 @@ def main() -> None:
                 "mfu": mfu,
                 "flops_per_image": FLOPS_PER_IMAGE,
                 "pallas_img_per_sec": pallas_img_per_sec,
+                "pallas_max_abs_diff": pallas_max_abs_diff,
                 "bf16_img_per_sec": bf16_img_per_sec,
+                "zoo_resnet18_bf16_img_per_sec": zoo_img_per_sec,
+                "zoo_resnet18_bf16_mfu": zoo_mfu,
             }
         )
+    )
+
+
+def _bench_resnet18():
+    """(images/sec, MFU) for resnet18(cifar_stem) bf16 training, batch 512.
+
+    ≙ the paper's "entire network" row (PDF Table 8) at a scale that can
+    saturate the MXU. bf16 compute via input dtype (nn layers follow
+    x.dtype; f32 master params, f32 BatchNorm statistics), MFU against the
+    bf16 peak with analytic model FLOPs.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from parallel_cnn_tpu.nn import cifar, resnet
+    from parallel_cnn_tpu.train import zoo
+
+    batch = 512
+    steps = 10
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(
+        rng.uniform(0, 1, (batch,) + cifar.IN_SHAPE).astype(np.float32)
+    ).astype(jnp.bfloat16)
+    y = jnp.asarray(rng.integers(0, 10, (batch,)).astype(np.int32))
+
+    model = resnet.resnet18(10, cifar_stem=True)
+    opt = zoo.make_optimizer(0.05)
+    st = zoo.init_state(model, jax.random.key(0), cifar.IN_SHAPE, opt)
+    step = zoo.make_train_step(model, opt)
+
+    st, loss = step(st, x, y)
+    _readback(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        st, loss = step(st, x, y)
+    _readback(loss)
+    sec = time.perf_counter() - t0
+    ips = steps * batch / sec
+    return round(ips, 1), round(
+        RESNET18_TRAIN_FLOPS_PER_IMAGE * ips / TPU_PEAK_BF16, 6
     )
 
 

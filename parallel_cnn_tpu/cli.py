@@ -110,6 +110,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     cfg = config_from_args(args)
 
+    # Surface the data pipeline's INFO-level evidence (e.g. the real-MNIST
+    # integrity report) in the driver; library embedders keep their own
+    # logging policy and a clean stdout.
+    import logging
+
+    logging.getLogger("parallel_cnn_tpu").setLevel(logging.INFO)
+    if not logging.getLogger().handlers:
+        logging.basicConfig(
+            level=logging.INFO, format="%(levelname)s %(name)s: %(message)s"
+        )
+
     import jax
 
     # Reliable platform override: the ambient plugin snapshots JAX_PLATFORMS

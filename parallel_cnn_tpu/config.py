@@ -88,6 +88,12 @@ class TrainConfig:
                 "batch dimension); use batch_size>1, or ops='reference' for "
                 "strict per-sample parity"
             )
+        if self.ops == "pallas" and self.dtype != "float32":
+            raise ValueError(
+                "ops='pallas' computes f32 (the fused megakernel casts its "
+                "inputs; a bf16 run would be silently mislabeled) — use "
+                "ops='reference' for bf16 throughput"
+            )
 
 
 @dataclasses.dataclass(frozen=True)

@@ -89,6 +89,48 @@ def load_pair(image_path: str, label_path: str) -> Tuple[np.ndarray, np.ndarray]
     return images, labels
 
 
+def integrity_report(
+    image_path: str, label_path: str, images=None, labels=None
+) -> dict:
+    """Structural + statistical integrity evidence for a real idx pair.
+
+    The reference snapshot ships genuine labels but no image blobs
+    (SURVEY.md B15), so accuracy claims on "real MNIST" hinge on the files a
+    user supplies. This report makes the claim checkable: file checksums
+    (compare against any published MNIST mirror), per-class label counts
+    (MNIST trains ~5.4-6.7k per digit), and the pixel mean (canonical MNIST
+    train mean ≈ 0.1307). Logged by the pipeline whenever real files load;
+    see README "Running on real MNIST".
+
+    Pass the already-parsed arrays when available so the report describes
+    EXACTLY the data the pipeline trains on (and the files aren't re-read);
+    only the checksums always stream the files.
+    """
+    import hashlib
+
+    def sha256(path):
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        return h.hexdigest()
+
+    if images is None:
+        images = load_idx_images(image_path)
+    if labels is None:
+        labels = load_idx_labels(label_path)
+    images, labels = np.asarray(images), np.asarray(labels)
+    hist = np.bincount(labels, minlength=10)
+    return {
+        "count": int(images.shape[0]),
+        "sha256_images": sha256(image_path),
+        "sha256_labels": sha256(label_path),
+        "label_counts": hist.tolist(),
+        "all_classes_present": bool((hist > 0).all()),
+        "pixel_mean": round(float(images.mean()), 5),
+    }
+
+
 def write_idx_images(path: str, images: np.ndarray) -> None:
     """Inverse of `load_idx_images` (for fixtures & the synthetic fallback)."""
     images = np.asarray(images)

@@ -27,6 +27,9 @@ class Dataset:
 
     images: np.ndarray  # (N, 28, 28) float32 in [0, 1]
     labels: np.ndarray  # (N,) int32
+    # "mnist" when parsed from real idx files, "synthetic" for the stand-in
+    # (SURVEY.md B15) — benchmark rows label themselves from this.
+    source: str = "synthetic"
 
     def __len__(self) -> int:
         return self.images.shape[0]
@@ -63,7 +66,18 @@ def load_split(
 
     try:
         imgs, labels = parse()
-        return Dataset(imgs, labels)
+        # Real files parsed: log the integrity evidence so every run on
+        # real MNIST is self-documenting (README "Running on real MNIST";
+        # cli.py raises this logger to INFO, and library embedders keep
+        # their stdout clean).
+        try:
+            rep = mnist.integrity_report(
+                images_path, labels_path, images=imgs, labels=labels
+            )
+            log.info("real MNIST idx verified: %s", rep)
+        except Exception:  # the report is evidence, never a failure mode
+            log.exception("integrity report failed for %s", images_path)
+        return Dataset(imgs, labels, source="mnist")
     except mnist.MnistError as e:
         if not cfg.synthetic_fallback:
             raise

@@ -7,6 +7,17 @@ Mosaic kernels over a batch-block grid. It is the "native compiled kernel"
 component of the framework — Pallas lowers to Mosaic, the TPU kernel
 compiler, exactly as CUDA C++ lowers to SASS.
 
+Two tiers, both compiled Mosaic on TPU:
+
+1. **Per-op kernel library** (conv_fwd … conv_wgrad, staged_…): one
+   pallas_call per reference kernel — the direct structural analog of the
+   CUDA backend's launch-per-kernel driver (CUDA/main.cu:110-159).
+2. **Fused megakernel** (`fused_value_and_ref_grads`, the product fast
+   path): the ENTIRE step's math in one pallas_call — round-2 measurement
+   showed the staged tier 6.3× slower than XLA path A because per-call
+   pipeline overhead + HBM round-trips dominate a 379-kFLOP model; the
+   fused tier beats path A on-chip (BENCH_r03).
+
 Design (empirically validated on TPU v5e Mosaic — see probe notes):
 
 - **Batch is the grid.** The reference launches one kernel per *sample*
@@ -21,19 +32,20 @@ Design (empirically validated on TPU v5e Mosaic — see probe notes):
 - **All contractions are rank-2 ``lax.dot_general`` on the MXU**; the 5×5
   conv is 25 unrolled tap-FMAs on the VPU (one vector op per tap, the
   systolic analog of the CUDA output-stationary loop, CUDA/layer.cu:116-130).
-- **Layout packing lives in XLA, FLOPs live in Pallas.** This Mosaic
-  version supports neither strided slices nor lane-splitting reshapes
-  in-kernel, so the stride-4 window gather for the pool layer and the
-  im2col patch matrices are built host-side (they are free or cheap
-  relayouts XLA already excels at) and the kernels see dense rank-2/3
-  blocks. Scalar stores to VMEM are also rejected — every kernel output is
-  a vector row or tile; the few true-scalar reductions (bias grads, error
-  norm) stay in XLA glue.
+- **Layout packing lives in XLA, FLOPs live in Pallas.** Mosaic supports
+  neither strided slices nor lane-splitting reshapes in-kernel, so the
+  staged tier builds the stride-4 pool windows and im2col patch matrices
+  host-side; the fused tier goes further and picks layouts that need no
+  packing at all (flat-576 lanes + the Mp scatter-matmul — see the fused
+  section). Scalar stores to VMEM are also rejected, and so are rank-1
+  vector relayouts — every kernel value stays rank-2+, and the few
+  true-scalar reductions (bias grads, error norm) stay in XLA glue.
 
 Numerics contract is identical to ops/reference.py (SURVEY.md §2.1): same
 /576 and /216 grad normalizations, same (onehot − output) error vector.
-Differential tests: tests/test_ops_pallas.py diffs this path against the
-jnp path A on an 8-device CPU harness in interpret mode.
+Differential tests: tests/test_ops_pallas.py diffs both tiers against the
+jnp path A on an 8-device CPU harness in interpret mode; bench.py diffs
+the fused tier on-chip (`pallas_max_abs_diff`).
 
 Flat layout convention: the 6×6×6 pool/FC boundary is flattened
 channel-major, lane = m*36 + x*6 + y — the same C-order flatten the
@@ -463,16 +475,20 @@ def predict(params: Params, xs: jax.Array) -> jax.Array:
     return jnp.argmax(forward(params, xs).out_f, axis=-1)
 
 
-def batched_value_and_ref_grads(
+def staged_value_and_ref_grads(
     params: Params, xs: jax.Array, ys: jax.Array
 ) -> Tuple[jax.Array, Params]:
-    """(err_mean, batch-mean reference grads) on the Pallas path.
+    """(err_mean, batch-mean reference grads) on the per-op kernel library.
 
-    Matches jax.vmap(ops.reference.value_and_ref_grads) + tree-mean to fp
-    tolerance; same reference contract (SURVEY.md §2.1), kernels instead of
-    XLA ops for every FLOP-bearing stage. Batches that don't tile
-    CONV_BLOCK are zero-padded; padded rows are masked out of the error
-    vector, so every grad contribution below is exactly zero for them.
+    One pallas_call per reference kernel (≙ the CUDA backend's one launch
+    per __global__ kernel, CUDA/main.cu:110-159) with HBM round-trips
+    between stages — kept as the kernel-library composition surface and the
+    differential anchor for the fused megakernel below, which is the
+    product fast path. Matches jax.vmap(ops.reference.value_and_ref_grads)
+    + tree-mean to fp tolerance; same reference contract (SURVEY.md §2.1).
+    Batches that don't tile CONV_BLOCK are zero-padded; padded rows are
+    masked out of the error vector, so every grad contribution below is
+    exactly zero for them.
     """
     n = xs.shape[0]
     pad = _pad_batch(n, CONV_BLOCK)
@@ -508,3 +524,262 @@ def batched_value_and_ref_grads(
         "f": {"w": g_w_f * inv_n, "b": g_b_f * inv_n},
     }
     return err_mean, grads
+
+
+# ---------------------------------------------------------------------------
+# Fused megakernel — the whole train-step math in ONE pallas_call
+# ---------------------------------------------------------------------------
+#
+# ≙ the CUDA backend's fused fp_f/bp_f kernels taken to their logical end
+# (CUDA/layer.cu:151-198 already fuses preact+bias+activation; the rest of
+# its step is 12 separate launches, CUDA/main.cu:110-159). Round-2 evidence
+# (BENCH_r02): the staged 7-call composition ran 6.3× SLOWER than XLA path A
+# because per-call pipeline overheads + HBM round-trips dominate a 379-kFLOP
+# model. This kernel keeps every intermediate in VMEM for the life of a
+# batch block and crosses HBM exactly once per tensor.
+#
+# Layout strategy (the part Mosaic dictates):
+# - Lane dim is the flat 24·24=576 conv pixel space — 4.5×128 exactly, so
+#   VPU rows waste nothing (the staged kernels' (…,24,24) blocks pad lane
+#   24→128, a 5.3× waste).
+# - The input arrives pre-im2col'd as (B, 25, 576): tap t = 5p+q rides the
+#   sublane dim, so the conv is 25 full-width FMAs per filter and the conv
+#   weight grad is 25 multiply+sublane-reduce rows — no in-kernel reshapes,
+#   which Mosaic would reject (lane-splitting).
+# - The stride-4 "pool" is a dense (576, 36) matmul: Mp[uv, xy] =
+#   w_s1[u−4x, v−4y] when (u,v) lies in window (x,y), else 0 — built ONCE
+#   from iota masks at grid step 0 and reused (the TPU grid is sequential;
+#   accumulator blocks persist in VMEM). Turning the sparse window scatter
+#   into a small MXU matmul removes the pack/unpack relayouts entirely;
+#   the transposed matmul is the backward scatter bp_output_c1.
+# - Per-channel (Bb, 36) pool/FC rows tolerate lane padding (they are
+#   ~0.4% of the VPU work).
+# - True-scalar reductions (‖·‖₂ totals, bias grads, the 16 window-tap
+#   sums) leave the kernel as small accumulator matrices and are finished
+#   by O(model-size) XLA ops — Mosaic rejects scalar stores to VMEM.
+
+
+def _fused_kernel(
+    x25_ref,      # (Bb, 25, 576) im2col'd input block
+    y1h_ref,      # (Bb, 16) one-hot labels (10 real + 6 pad lanes)
+    w_c1_ref,     # (6, 25)
+    b_c1_ref,     # (6, 1)
+    w_s1_ref,     # (16, 1) flat 4×4 pool kernel
+    b_s1_ref,     # (1, 1)
+    w_f_ref,      # (6, 36, 10) FC weight, channel-major split
+    b_f_ref,      # (1, 10)
+    # accumulator outputs (constant index map → persist across the grid)
+    mp_ref,       # (576, 36) pool scatter matrix (built at step 0)
+    err_ref,      # (1, 128) Σ per-sample ‖d_pre_f‖₂ (all lanes identical)
+    gwf_ref,      # (6, 36, 10) Σ_b out_s1 ⊗ d_pre_f, channel-major
+    gbf_ref,      # (1, 10) Σ_b d_pre_f
+    cpool_ref,    # (576, 36) Σ_{b,m} out_c1 ⊗ d_pre_s1 (window-grad matrix)
+    gbs1_ref,     # (1, 36) Σ_{b,m} d_pre_s1
+    gwc1_ref,     # (150, 576) row m·25+t = Σ_b d_pre_c1[m] ⊙ x25[t]
+    gbc1_ref,     # (6, 576) Σ_b d_pre_c1[m]
+):
+    f32 = err_ref.dtype
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        # Mp[uv, xy] = Σ_t w_s1[t] · [uv in window xy at tap t]: the pool's
+        # scatter structure as data, so fwd/bwd pooling are MXU matmuls.
+        uv = lax.broadcasted_iota(jnp.int32, (576, 36), 0)
+        xy = lax.broadcasted_iota(jnp.int32, (576, 36), 1)
+        di = uv // 24 - 4 * (xy // 6)
+        dj = uv % 24 - 4 * (xy % 6)
+        mp = jnp.zeros((576, 36), f32)
+        for t in range(16):
+            mp += jnp.where((di == t // 4) & (dj == t % 4), w_s1_ref[t, 0], 0.0)
+        mp_ref[:] = mp
+        err_ref[:] = jnp.zeros_like(err_ref)
+        gwf_ref[:] = jnp.zeros_like(gwf_ref)
+        gbf_ref[:] = jnp.zeros_like(gbf_ref)
+        cpool_ref[:] = jnp.zeros_like(cpool_ref)
+        gbs1_ref[:] = jnp.zeros_like(gbs1_ref)
+        gwc1_ref[:] = jnp.zeros_like(gwc1_ref)
+        gbc1_ref[:] = jnp.zeros_like(gbc1_ref)
+
+    mp = mp_ref[:]
+    dot = functools.partial(
+        lax.dot_general,
+        preferred_element_type=f32,
+        precision=lax.Precision.HIGHEST,
+    )
+
+    # Forward: conv (25 tap-FMAs/filter) → pool (Mp matmul) → FC.
+    outs_c1 = []
+    outs_s1 = []
+    pre_f = jnp.broadcast_to(b_f_ref[:], (x25_ref.shape[0], 10))
+    for m in range(6):
+        acc = jnp.full(x25_ref.shape[:1] + (576,), b_c1_ref[m, 0], f32)
+        for t in range(25):
+            acc += w_c1_ref[m, t] * x25_ref[:, t, :]
+        out_m = _sigmoid(acc)                                   # (Bb, 576)
+        outs_c1.append(out_m)
+        pre_s1_m = dot(out_m, mp, (((1,), (0,)), ((), ()))) + b_s1_ref[0, 0]
+        out_s1_m = _sigmoid(pre_s1_m)                           # (Bb, 36)
+        outs_s1.append(out_s1_m)
+        pre_f = pre_f + dot(out_s1_m, w_f_ref[m], (((1,), (0,)), ((), ())))
+    out_f = _sigmoid(pre_f)
+
+    # makeError + ‖·‖₂. Lane 10 of the one-hot block is the pad-sample mask
+    # (1 for real rows, 0 for zero-padded rows): it zeroes d_pre_f, and with
+    # it every grad and err contribution of the pad — so no grad masking is
+    # needed anywhere downstream.
+    mask = y1h_ref[:, 10:11]                                    # (Bb, 1)
+    d_pre_f = (y1h_ref[:, :10] - out_f) * mask                  # (Bb, 10)
+    # rank-2 throughout: Mosaic rejects rank-1 vector relayouts
+    norms = jnp.sqrt(jnp.sum(d_pre_f * d_pre_f, axis=1, keepdims=True))
+    err_ref[:] = err_ref[:] + jnp.sum(norms)
+
+    # FC backward (≙ bp_weight_f/bp_bias_f/bp_output_s1, fused).
+    gbf_ref[:] += jnp.sum(d_pre_f, axis=0, keepdims=True)
+    for m in range(6):
+        out_s1_m = outs_s1[m]
+        gwf_ref[m] += dot(out_s1_m, d_pre_f, (((0,), (0,)), ((), ())))
+        d_out_s1_m = dot(d_pre_f, w_f_ref[m], (((1,), (1,)), ((), ())))
+        d_pre_s1_m = d_out_s1_m * out_s1_m * (1.0 - out_s1_m)   # (Bb, 36)
+        gbs1_ref[:] += jnp.sum(d_pre_s1_m, axis=0, keepdims=True)
+        out_m = outs_c1[m]
+        # window-grad matrix: finished into g_w_s1 by XLA diagonal-einsum
+        cpool_ref[:] += dot(out_m, d_pre_s1_m, (((0,), (0,)), ((), ())))
+        # pool scatter-back + σ′ (≙ bp_output_c1 + bp_preact_c1)
+        d_out_c1_m = dot(d_pre_s1_m, mp, (((1,), (1,)), ((), ())))
+        d_pre_c1_m = d_out_c1_m * out_m * (1.0 - out_m)         # (Bb, 576)
+        gbc1_ref[m : m + 1, :] += jnp.sum(d_pre_c1_m, axis=0, keepdims=True)
+        # conv weight grad: 25 multiply+sublane-reduce rows per filter
+        # (≙ bp_weight_c1's per-tap correlation, CUDA/layer.cu:307-335)
+        for t in range(25):
+            r = m * 25 + t
+            gwc1_ref[r : r + 1, :] += jnp.sum(
+                d_pre_c1_m * x25_ref[:, t, :], axis=0, keepdims=True
+            )
+
+
+FUSED_BLOCK = 64  # Mosaic's scoped-VMEM accounting charges the unrolled
+                  # tap loops' temporaries (measured: 25.0 MB at Bb=64,
+                  # 17.2 MB at Bb=32 against the DEFAULT 16 MB scoped
+                  # limit) — so the call raises vmem_limit_bytes below;
+                  # v5e VMEM is 128 MB, and the larger block quarters the
+                  # number of grid steps (fixed per-step accumulator RMW
+                  # work is the throughput limiter at small blocks).
+FUSED_VMEM_LIMIT = 64 * 1024 * 1024
+
+
+def _fused_call(x25, y1h, params, n_pad: int):
+    bb = _batch_block(n_pad, FUSED_BLOCK)
+    f32 = jnp.float32
+    outs = pl.pallas_call(
+        _fused_kernel,
+        grid=(n_pad // bb,),
+        in_specs=[
+            pl.BlockSpec((bb, 25, 576), lambda g: (g, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, 16), lambda g: (g, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((6, 25), lambda g: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((6, 1), lambda g: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((16, 1), lambda g: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda g: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((6, 36, 10), lambda g: (0, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 10), lambda g: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((576, 36), lambda g: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 128), lambda g: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((6, 36, 10), lambda g: (0, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 10), lambda g: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((576, 36), lambda g: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 36), lambda g: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((150, 576), lambda g: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((6, 576), lambda g: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((576, 36), f32),   # Mp (scratch-as-output)
+            jax.ShapeDtypeStruct((1, 128), f32),    # err
+            jax.ShapeDtypeStruct((6, 36, 10), f32), # gwf
+            jax.ShapeDtypeStruct((1, 10), f32),     # gbf
+            jax.ShapeDtypeStruct((576, 36), f32),   # cpool
+            jax.ShapeDtypeStruct((1, 36), f32),     # gbs1
+            jax.ShapeDtypeStruct((150, 576), f32),  # gwc1 rows
+            jax.ShapeDtypeStruct((6, 576), f32),    # gbc1 rows
+        ],
+        interpret=_interpret(),
+        compiler_params=None if _interpret() else pltpu.CompilerParams(
+            vmem_limit_bytes=FUSED_VMEM_LIMIT
+        ),
+    )(
+        x25,
+        y1h,
+        params["c1"]["w"].reshape(6, 25).astype(f32),
+        params["c1"]["b"].reshape(6, 1).astype(f32),
+        params["s1"]["w"].reshape(16, 1).astype(f32),
+        params["s1"]["b"].reshape(1, 1).astype(f32),
+        params["f"]["w"].reshape(10, 6, 36).transpose(1, 2, 0).astype(f32),
+        params["f"]["b"].reshape(1, 10).astype(f32),
+    )
+    return outs
+
+
+def fused_value_and_ref_grads(
+    params: Params, xs: jax.Array, ys: jax.Array
+) -> Tuple[jax.Array, Params]:
+    """(err_mean, batch-mean reference grads): the whole step's math in one
+    Mosaic kernel + O(model-size) XLA finish ops.
+
+    Differential contract: matches `staged_value_and_ref_grads` and path A
+    (`jax.vmap(ops.reference.value_and_ref_grads)` + tree-mean) to fp
+    tolerance — tests/test_ops_pallas.py, and on-chip in bench.py's
+    `pallas_max_abs_diff` row.
+    """
+    n = xs.shape[0]
+    f32 = jnp.float32
+    pad = _pad_batch(n, min(n, FUSED_BLOCK))
+    if pad:
+        xs = jnp.concatenate([xs, jnp.zeros((pad,) + xs.shape[1:], xs.dtype)])
+    n_pad = n + pad
+
+    # Host-side prep (cheap XLA relayouts): im2col the input once — tap
+    # t = 5p+q on the sublane dim, flat pixel uv on the lane dim.
+    x25 = lax.conv_general_dilated_patches(
+        xs[:, None].astype(f32), (5, 5), (1, 1), "VALID"
+    ).reshape(n_pad, 25, 576)
+    # One-hot labels padded to 16 lanes; lane 10 doubles as the pad-sample
+    # mask (1 for real rows, 0 for pad rows — zeroing d_pre_f and with it
+    # every grad & err contribution of the pad).
+    y1h = jnp.zeros((n_pad, 16), f32)
+    y1h = y1h.at[jnp.arange(n), ys].set(1.0, mode="drop")
+    y1h = y1h.at[:n, 10].set(1.0)
+
+    (mp, err, gwf, gbf, cpool, gbs1, gwc1, gbc1) = _fused_call(
+        x25, y1h, params, n_pad
+    )
+    del mp  # Mp is kernel-internal state; outputs are the contract below
+
+    inv_n = 1.0 / n
+    err_mean = err[0, 0] * inv_n
+
+    # XLA finish ops — each O(model size), no batch dimension left:
+    # FC weight grad arrives channel-major transposed: (6, 36, 10) → (10, 216)
+    g_w_f = gwf.transpose(2, 0, 1).reshape(10, 216) * inv_n
+    g_b_f = gbf.reshape(10) * inv_n
+    # g_w_s1[i,j] = Σ_{x,y} cpool[(4x+i)·24+4y+j, (x,y)]: diagonal einsum
+    # over the window-grad matrix (repeated labels extract the diagonal).
+    g_w_s1 = jnp.einsum("xiyjxy->ij", cpool.reshape(6, 4, 6, 4, 6, 6)) * inv_n
+    g_b_s1 = jnp.sum(gbs1) / ref_ops.POOL_BIAS_NORM * inv_n
+    g_w_c1 = (
+        jnp.sum(gwc1, axis=1).reshape(6, 5, 5) / ref_ops.CONV_NORM * inv_n
+    )
+    g_b_c1 = jnp.sum(gbc1, axis=1) / ref_ops.CONV_NORM * inv_n
+
+    grads: Params = {
+        "c1": {"w": g_w_c1, "b": g_b_c1},
+        "s1": {"w": g_w_s1, "b": g_b_s1},
+        "f": {"w": g_w_f, "b": g_b_f},
+    }
+    return err_mean, grads
+
+
+# The product fast path (--ops pallas, train/step.py, bench.py) is the
+# fused megakernel; the staged per-op composition stays as the kernel
+# library's differential anchor.
+batched_value_and_ref_grads = fused_value_and_ref_grads

@@ -144,26 +144,40 @@ def _sample_grads(params: Params, x: jax.Array, y: jax.Array):
     return _backward_local(params, x, acts, y)
 
 
-def make_2d_step(mesh: Mesh, dt: float, global_batch: int):
+def make_2d_step(mesh: Mesh, dt: float, global_batch: int,
+                 compute_dtype: str | None = None):
     """Hybrid DP×model-parallel train step over the full 2-D mesh.
 
     params follow PARAM_SPECS; x:(B,28,28) / y:(B,) are sharded over the
     data axis and replicated over model. One jitted program; grads are
     psum-reduced over ``data`` (DP) while activations/grads inside each
     sample are decomposed over ``model`` (intra-op).
+
+    compute_dtype="bfloat16": the per-sample forward/backward (including
+    the model-axis activation psum) runs bf16; grads are cast back to f32
+    BEFORE the data-axis psum, and params stay f32 master weights — the
+    same mixed-precision recipe as train/step.py batched_step, composed
+    with both mesh axes.
     """
 
     n_data = mesh.shape[DATA_AXIS]
+    cdt = jnp.dtype(compute_dtype or "float32")
 
     def shard_body(params: Params, x: jax.Array, y: jax.Array):
         if x.shape[0] * n_data != global_batch:
             raise ValueError(
                 f"batch {x.shape[0] * n_data} != global_batch {global_batch}"
             )
-        errs, grads = jax.vmap(_sample_grads, in_axes=(None, 0, 0))(params, x, y)
-        err_sum = lax.psum(jnp.sum(errs), DATA_AXIS)
+        cparams = jax.tree_util.tree_map(lambda p: p.astype(cdt), params)
+        errs, grads = jax.vmap(_sample_grads, in_axes=(None, 0, 0))(
+            cparams, x.astype(cdt), y
+        )
+        err_sum = lax.psum(jnp.sum(errs.astype(jnp.float32)), DATA_AXIS)
         grad_sum = jax.tree_util.tree_map(
-            lambda g: lax.psum(jnp.sum(g, axis=0), DATA_AXIS), grads
+            lambda g: lax.psum(
+                jnp.sum(g.astype(jnp.float32), axis=0), DATA_AXIS
+            ),
+            grads,
         )
         mean_grads = jax.tree_util.tree_map(lambda g: g / global_batch, grad_sum)
         return apply_grad(params, mean_grads, dt), err_sum / global_batch

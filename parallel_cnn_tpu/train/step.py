@@ -108,6 +108,11 @@ def pallas_batched_step(
     from parallel_cnn_tpu.ops import pallas as pk
 
     cdt = jnp.dtype(compute_dtype or "float32")
+    if cdt != jnp.float32:
+        # The fused megakernel casts inputs to f32 internally — honoring a
+        # bf16 request silently would mislabel the run (config.py rejects
+        # the combination at the driver level; this guards direct callers).
+        raise ValueError("the pallas path computes f32; use ops='reference' for bf16")
     cparams = jax.tree_util.tree_map(lambda p: p.astype(cdt), params)
     err, mean_grads = pk.batched_value_and_ref_grads(cparams, x.astype(cdt), y)
     mean_grads = jax.tree_util.tree_map(

@@ -63,11 +63,12 @@ def _maybe_mesh(cfg: Config):
             "mesh training is the minibatch throughput mode; batch_size=1 "
             "strict parity is inherently sequential and single-device"
         )
-    if tc.ops == "pallas":
-        raise ValueError("ops='pallas' is single-device; use ops='reference' with a mesh")
-    if tc.dtype != "float32":
-        raise ValueError("mesh training is float32 (bf16 not wired through shard_map yet)")
     mesh = mesh_lib.make_mesh(mc)
+    if tc.ops == "pallas" and mesh.shape[mesh_lib.MODEL_AXIS] > 1:
+        raise ValueError(
+            "ops='pallas' composes with the data axis only (the fused "
+            "kernel is batch-local); use --mesh-model 1 or ops='reference'"
+        )
     n_data, n_model = mesh.shape[mesh_lib.DATA_AXIS], mesh.shape[mesh_lib.MODEL_AXIS]
     if 6 % n_model:
         raise ValueError(
@@ -158,12 +159,14 @@ def learn(
         if mesh.shape[mesh_lib.MODEL_AXIS] > 1:
             params = intra_op.shard_params(mesh, params)
             mesh_step = intra_op.make_2d_step(
-                mesh, dt=tc.dt, global_batch=tc.batch_size
+                mesh, dt=tc.dt, global_batch=tc.batch_size,
+                compute_dtype=tc.dtype,
             )
         else:
             params = mesh_lib.replicate(mesh, params)
             mesh_step = data_parallel.make_dp_step(
-                mesh, dt=tc.dt, global_batch=tc.batch_size
+                mesh, dt=tc.dt, global_batch=tc.batch_size,
+                compute_dtype=tc.dtype, ops_path=tc.ops,
             )
         if verbose:
             print(f"mesh: {dict(mesh.shape)}")

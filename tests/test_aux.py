@@ -193,6 +193,9 @@ def test_two_process_distributed_smoke(tmp_path):
             PCNN_COORDINATOR=f"127.0.0.1:{port}",
             PCNN_NUM_PROCESSES="2",
             PCNN_PROCESS_ID=str(rank),
+            # 4 virtual devices per process → an 8-device GLOBAL mesh for
+            # the cross-rank DP training steps (overrides conftest's 8).
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
         )
         procs.append(
             subprocess.Popen(
@@ -220,3 +223,34 @@ def test_two_process_distributed_smoke(tmp_path):
         _, nproc, pid, gathered = line.split()
         assert nproc == "2" and pid == str(rank)
         assert gathered == "0,1"  # the collective saw BOTH processes
+
+    # Multi-PROCESS DP training (≙ the MPI driver training across ranks,
+    # MPI/Main.cpp:43-112): both ranks ran 3 DP steps over the global
+    # 8-device mesh (4 local devices each) and must agree with each other
+    # AND with the single-process trajectory on this process's 8 devices.
+    # (Constants mirror _distributed_worker.py — asserted below rather than
+    # imported, because importing the worker would run its module-level
+    # jax.config mutations in THIS process.)
+    n, b = 3, 16
+
+    trains = []
+    for out in outs:
+        line = [l for l in out.splitlines() if l.startswith("TRAIN")][0]
+        trains.append([float(v) for v in line.split()[1].split(",")])
+    assert trains[0] == trains[1], "ranks diverged (the reference's bug B7)"
+    assert len(trains[0]) == n, "worker TRAIN_STEPS drifted from the test's"
+
+    from parallel_cnn_tpu.models import lenet_ref
+    from parallel_cnn_tpu.train import step as step_lib
+
+    params = lenet_ref.init(jax.random.key(7))
+    rng = np.random.default_rng(123)
+    xs = rng.uniform(0, 1, (n, b, 28, 28)).astype(np.float32)
+    ys = rng.integers(0, 10, (n, b)).astype(np.int32)
+    ref_errs = []
+    for i in range(n):
+        params, e = step_lib.batched_step(
+            params, jnp.asarray(xs[i]), jnp.asarray(ys[i]), 0.1
+        )
+        ref_errs.append(float(e))
+    np.testing.assert_allclose(trains[0], ref_errs, rtol=1e-5)

@@ -97,6 +97,40 @@ def test_batched_grads_jit_compatible(params, batch):
     tree_allclose(grads_j, grads_e, atol=1e-6)
 
 
+def test_staged_tier_matches_fused_tier(params, batch):
+    """The per-op kernel library (staged tier, one pallas_call per
+    reference kernel) and the fused megakernel must agree — the same
+    differential the reference implies between its Sequential and CUDA
+    backends, here between our two compiled tiers."""
+    xs, ys = batch
+    err_s, grads_s = pk.staged_value_and_ref_grads(params, xs, ys)
+    err_f, grads_f = pk.fused_value_and_ref_grads(params, xs, ys)
+    np.testing.assert_allclose(float(err_s), float(err_f), atol=1e-6)
+    tree_allclose(grads_s, grads_f, atol=1e-5)
+
+
+def test_fused_multi_grid_step_accumulation(monkeypatch):
+    """Shrink FUSED_BLOCK so the fused tier runs a MULTI-step grid with a
+    padded tail (grid=3 with 2 pad rows) — exercising the cross-grid-step
+    accumulator init/accumulate logic and the Mp persistence that the
+    single-block small-batch tests never reach (on TPU the bench covers
+    grid=32; this is the CPU-harness equivalent)."""
+    monkeypatch.setattr(pk, "FUSED_BLOCK", 4)
+    params = lenet_ref.init(jax.random.key(3))
+    rng = np.random.default_rng(9)
+    n = 10  # pads to 12 = 3 blocks of 4
+    xs = jnp.asarray(rng.uniform(0, 1, (n, 28, 28)).astype(np.float32))
+    ys = jnp.asarray(rng.integers(0, 10, (n,)).astype(np.int32))
+    err_f, grads_f = pk.fused_value_and_ref_grads(params, xs, ys)
+    errs, grads = jax.vmap(ops.value_and_ref_grads, in_axes=(None, 0, 0))(
+        params, xs, ys
+    )
+    np.testing.assert_allclose(float(err_f), float(jnp.mean(errs)), atol=1e-6)
+    tree_allclose(
+        grads_f, jax.tree_util.tree_map(lambda g: jnp.mean(g, axis=0), grads)
+    )
+
+
 def test_uneven_batch_pads_and_masks():
     """Batches that don't tile CONV_BLOCK are zero-padded; the pad rows must
     contribute exactly nothing to the error or any gradient."""
