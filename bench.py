@@ -210,9 +210,13 @@ def main() -> None:
                 make_epoch(pk.batched_value_and_ref_grads), params, images, labels
             )
             pallas_img_per_sec = round(n_images / pallas_compute, 1)
-            # On-chip A-vs-B grad parity on one batch (kernel_authoring.md
-            # rule 5: interpret-mode tests can't catch Mosaic lowering gaps
-            # — this line is the compiled-numerics evidence).
+        except Exception as e:  # labeled, not fatal
+            pallas_img_per_sec = f"error: {type(e).__name__}: {e}"[:200]
+        # On-chip A-vs-B grad parity on one batch (kernel_authoring.md
+        # rule 5: interpret-mode tests can't catch Mosaic lowering gaps —
+        # this line is the compiled-numerics evidence). Own try block: a
+        # parity-check failure must not discard a measured throughput.
+        try:
             ba = make_batch_grads("float32")
             _, grads_a = jax.jit(ba)(params, images[0], labels[0])
             _, grads_b = jax.jit(pk.batched_value_and_ref_grads)(
@@ -230,8 +234,8 @@ def main() -> None:
                 pallas_img_per_sec = (
                     f"parity-failure: max_abs_diff {pallas_max_abs_diff:.3e}"
                 )
-        except Exception as e:  # labeled, not fatal
-            pallas_img_per_sec = f"error: {type(e).__name__}: {e}"[:200]
+        except Exception as e:
+            pallas_max_abs_diff = f"error: {type(e).__name__}: {e}"[:200]
 
     # bf16 throughput mode (train/step.py batched_step compute_dtype):
     # f32 master weights, bf16 compute on the MXU — the documented
@@ -252,11 +256,21 @@ def main() -> None:
     # framework's ceiling is judged on.
     zoo_img_per_sec = None
     zoo_mfu = None
+    zoo_pallasconv_img_per_sec = None
     if platform == "tpu" or os.environ.get("PCNN_BENCH_ZOO"):
         try:
             zoo_img_per_sec, zoo_mfu = _bench_resnet18()
         except Exception as e:  # labeled, not fatal
             zoo_img_per_sec = f"error: {type(e).__name__}: {e}"[:200]
+        # Config #4's native-kernel cell: the same ResNet-18 with EVERY
+        # conv routed through the Pallas tapped-matmul kernels
+        # (ops/pallas_conv.py) instead of XLA's convs.
+        try:
+            zoo_pallasconv_img_per_sec, _ = _bench_resnet18(
+                conv_backend="pallas"
+            )
+        except Exception as e:
+            zoo_pallasconv_img_per_sec = f"error: {type(e).__name__}: {e}"[:200]
 
     # MFU on TPU by default (v5e peaks, dtype-matched), or on any platform
     # when the user supplies their chip's peak via PCNN_PEAK_FLOPS*.
@@ -283,12 +297,13 @@ def main() -> None:
                 "bf16_img_per_sec": bf16_img_per_sec,
                 "zoo_resnet18_bf16_img_per_sec": zoo_img_per_sec,
                 "zoo_resnet18_bf16_mfu": zoo_mfu,
+                "zoo_resnet18_pallasconv_bf16_img_per_sec": zoo_pallasconv_img_per_sec,
             }
         )
     )
 
 
-def _bench_resnet18():
+def _bench_resnet18(conv_backend: str = "xla"):
     """(images/sec, MFU) for resnet18(cifar_stem) bf16 training, batch 512.
 
     ≙ the paper's "entire network" row (PDF Table 8) at a scale that can
@@ -311,7 +326,7 @@ def _bench_resnet18():
     ).astype(jnp.bfloat16)
     y = jnp.asarray(rng.integers(0, 10, (batch,)).astype(np.int32))
 
-    model = resnet.resnet18(10, cifar_stem=True)
+    model = resnet.resnet18(10, cifar_stem=True, conv_backend=conv_backend)
     opt = zoo.make_optimizer(0.05)
     st = zoo.init_state(model, jax.random.key(0), cifar.IN_SHAPE, opt)
     step = zoo.make_train_step(model, opt)

@@ -70,13 +70,14 @@ def load_split(
         # real MNIST is self-documenting (README "Running on real MNIST";
         # cli.py raises this logger to INFO, and library embedders keep
         # their stdout clean).
-        try:
-            rep = mnist.integrity_report(
-                images_path, labels_path, images=imgs, labels=labels
-            )
-            log.info("real MNIST idx verified: %s", rep)
-        except Exception:  # the report is evidence, never a failure mode
-            log.exception("integrity report failed for %s", images_path)
+        if log.isEnabledFor(logging.INFO):  # sha256 streams both files
+            try:
+                rep = mnist.integrity_report(
+                    images_path, labels_path, images=imgs, labels=labels
+                )
+                log.info("real MNIST idx verified: %s", rep)
+            except Exception:  # the report is evidence, never a failure mode
+                log.exception("integrity report failed for %s", images_path)
         return Dataset(imgs, labels, source="mnist")
     except mnist.MnistError as e:
         if not cfg.synthetic_fallback:

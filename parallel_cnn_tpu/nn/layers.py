@@ -26,13 +26,21 @@ def _he_normal(key, shape, fan_in, dtype):
 
 @dataclasses.dataclass(frozen=True)
 class Conv2D(Module):
-    """features × (kh, kw) conv, stride/padding configurable, He init."""
+    """features × (kh, kw) conv, stride/padding configurable, He init.
+
+    backend="pallas" routes supported shapes (3×3/1×1, stride 1/2, SAME)
+    through the hand-written tapped-matmul kernels in ops/pallas_conv.py —
+    the zoo's native-kernel path (BASELINE.json config #4). Unsupported
+    shapes raise at construction-use time rather than silently falling
+    back, so a "pallas" model is what it claims to be.
+    """
 
     features: int
     kernel: Tuple[int, int] = (3, 3)
     strides: Tuple[int, int] = (1, 1)
     padding: str = "SAME"
     use_bias: bool = True
+    backend: str = "xla"
 
     def init(self, key, in_shape: Shape):
         h, w, c = in_shape
@@ -54,13 +62,25 @@ class Conv2D(Module):
         return params, {}, tuple(out[1:])
 
     def apply(self, params, state, x, train: bool = False):
-        y = lax.conv_general_dilated(
-            x,
-            params["w"].astype(x.dtype),
-            self.strides,
-            self.padding,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        )
+        if self.backend == "pallas":
+            from parallel_cnn_tpu.ops import pallas_conv
+
+            if not pallas_conv.supports(self.kernel, self.strides, self.padding):
+                raise ValueError(
+                    f"pallas conv backend does not cover kernel={self.kernel} "
+                    f"strides={self.strides} padding={self.padding!r}"
+                )
+            y = pallas_conv.conv2d(
+                x, params["w"].astype(x.dtype), self.strides[0]
+            )
+        else:
+            y = lax.conv_general_dilated(
+                x,
+                params["w"].astype(x.dtype),
+                self.strides,
+                self.padding,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
         if self.use_bias:
             y = y + params["b"].astype(x.dtype)
         return y, state

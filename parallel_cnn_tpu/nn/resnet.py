@@ -29,14 +29,17 @@ class BasicBlock(Module):
 
     features: int
     stride: int = 1
+    conv_backend: str = "xla"
 
     def _branches(self):
         main = Sequential(
             [
-                Conv2D(self.features, strides=(self.stride, self.stride), use_bias=False),
+                Conv2D(self.features, strides=(self.stride, self.stride),
+                       use_bias=False, backend=self.conv_backend),
                 BatchNorm(),
                 ReLU(),
-                Conv2D(self.features, use_bias=False),
+                Conv2D(self.features, use_bias=False,
+                       backend=self.conv_backend),
                 BatchNorm(),
             ]
         )
@@ -47,6 +50,7 @@ class BasicBlock(Module):
                     kernel=(1, 1),
                     strides=(self.stride, self.stride),
                     use_bias=False,
+                    backend=self.conv_backend,
                 ),
                 BatchNorm(),
             ]
@@ -83,23 +87,27 @@ class Bottleneck(Module):
 
     features: int  # bottleneck width; output is 4× this
     stride: int = 1
+    conv_backend: str = "xla"
     EXPANSION = 4
 
     def _branches(self):
         out_ch = self.features * self.EXPANSION
         main = Sequential(
             [
-                Conv2D(self.features, kernel=(1, 1), use_bias=False),
+                Conv2D(self.features, kernel=(1, 1), use_bias=False,
+                       backend=self.conv_backend),
                 BatchNorm(),
                 ReLU(),
                 Conv2D(
                     self.features,
                     strides=(self.stride, self.stride),
                     use_bias=False,
+                    backend=self.conv_backend,
                 ),
                 BatchNorm(),
                 ReLU(),
-                Conv2D(out_ch, kernel=(1, 1), use_bias=False),
+                Conv2D(out_ch, kernel=(1, 1), use_bias=False,
+                       backend=self.conv_backend),
                 BatchNorm(),
             ]
         )
@@ -110,6 +118,7 @@ class Bottleneck(Module):
                     kernel=(1, 1),
                     strides=(self.stride, self.stride),
                     use_bias=False,
+                    backend=self.conv_backend,
                 ),
                 BatchNorm(),
             ]
@@ -140,9 +149,12 @@ class Bottleneck(Module):
         return jax.nn.relu(y + sc), new_state
 
 
-def _stage(block_cls, features: int, count: int, stride: int) -> Sequence[Module]:
+def _stage(
+    block_cls, features: int, count: int, stride: int, conv_backend: str
+) -> Sequence[Module]:
     return [
-        block_cls(features, stride if i == 0 else 1) for i in range(count)
+        block_cls(features, stride if i == 0 else 1, conv_backend)
+        for i in range(count)
     ]
 
 
@@ -151,10 +163,18 @@ def _resnet(
     stage_sizes: Sequence[int],
     num_classes: int,
     cifar_stem: bool,
+    conv_backend: str = "xla",
 ) -> Sequential:
     if cifar_stem:
-        stem = [Conv2D(64, use_bias=False), BatchNorm(), ReLU()]
+        stem = [
+            Conv2D(64, use_bias=False, backend=conv_backend),
+            BatchNorm(),
+            ReLU(),
+        ]
     else:
+        # 7×7 stem stays XLA — outside the pallas kernel library's shape
+        # coverage (ops/pallas_conv.py:supports); every other conv in the
+        # network is 3×3 or 1×1.
         stem = [
             Conv2D(64, kernel=(7, 7), strides=(2, 2), use_bias=False),
             BatchNorm(),
@@ -163,21 +183,29 @@ def _resnet(
         ]
     layers = list(stem)
     for i, (features, count) in enumerate(zip((64, 128, 256, 512), stage_sizes)):
-        layers += _stage(block_cls, features, count, stride=1 if i == 0 else 2)
+        layers += _stage(
+            block_cls, features, count, 1 if i == 0 else 2, conv_backend
+        )
     layers += [GlobalAvgPool(), Dense(num_classes)]
     return Sequential(layers)
 
 
-def resnet18(num_classes: int = 10, cifar_stem: bool = True) -> Sequential:
-    return _resnet(BasicBlock, (2, 2, 2, 2), num_classes, cifar_stem)
+def resnet18(
+    num_classes: int = 10, cifar_stem: bool = True, conv_backend: str = "xla"
+) -> Sequential:
+    return _resnet(BasicBlock, (2, 2, 2, 2), num_classes, cifar_stem, conv_backend)
 
 
-def resnet34(num_classes: int = 10, cifar_stem: bool = True) -> Sequential:
-    return _resnet(BasicBlock, (3, 4, 6, 3), num_classes, cifar_stem)
+def resnet34(
+    num_classes: int = 10, cifar_stem: bool = True, conv_backend: str = "xla"
+) -> Sequential:
+    return _resnet(BasicBlock, (3, 4, 6, 3), num_classes, cifar_stem, conv_backend)
 
 
-def resnet50(num_classes: int = 1000, cifar_stem: bool = False) -> Sequential:
-    return _resnet(Bottleneck, (3, 4, 6, 3), num_classes, cifar_stem)
+def resnet50(
+    num_classes: int = 1000, cifar_stem: bool = False, conv_backend: str = "xla"
+) -> Sequential:
+    return _resnet(Bottleneck, (3, 4, 6, 3), num_classes, cifar_stem, conv_backend)
 
 
 def num_params(params) -> int:

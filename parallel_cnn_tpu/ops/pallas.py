@@ -542,10 +542,13 @@ def staged_value_and_ref_grads(
 # - Lane dim is the flat 24·24=576 conv pixel space — 4.5×128 exactly, so
 #   VPU rows waste nothing (the staged kernels' (…,24,24) blocks pad lane
 #   24→128, a 5.3× waste).
-# - The input arrives pre-im2col'd as (B, 25, 576): tap t = 5p+q rides the
-#   sublane dim, so the conv is 25 full-width FMAs per filter and the conv
+# - The input arrives pre-im2col'd in TAP-MAJOR layout (25, B, 576): each
+#   tap read `x25_ref[t]` is a dense leading-dim slice (whole (Bb,576)
+#   tiles), so the conv is 25 full-width FMAs per filter and the conv
 #   weight grad is 25 multiply+sublane-reduce rows — no in-kernel reshapes,
-#   which Mosaic would reject (lane-splitting).
+#   which Mosaic would reject (lane-splitting). Measured: the batch-major
+#   (Bb, 25, 576) alternative makes every tap read a strided mid-dim slice
+#   and costs 30% end-to-end (940k → 1,218k img/s at Bb=64 on v5e).
 # - The stride-4 "pool" is a dense (576, 36) matmul: Mp[uv, xy] =
 #   w_s1[u−4x, v−4y] when (u,v) lies in window (x,y), else 0 — built ONCE
 #   from iota masks at grid step 0 and reused (the TPU grid is sequential;
@@ -557,10 +560,15 @@ def staged_value_and_ref_grads(
 # - True-scalar reductions (‖·‖₂ totals, bias grads, the 16 window-tap
 #   sums) leave the kernel as small accumulator matrices and are finished
 #   by O(model-size) XLA ops — Mosaic rejects scalar stores to VMEM.
+# - Dots run Precision.DEFAULT, matching path A's on-chip precision (XLA
+#   also runs DEFAULT): measured 13% faster than HIGHEST (6-pass f32
+#   emulation) AND a TIGHTER on-chip diff vs path A (4e-4 vs 1.2e-3,
+#   because both sides round the same way). CPU interpret-mode tests are
+#   exact either way (no bf16 passes on CPU).
 
 
 def _fused_kernel(
-    x25_ref,      # (Bb, 25, 576) im2col'd input block
+    x25_ref,      # (25, Bb, 576) im2col'd input block, tap-major
     y1h_ref,      # (Bb, 16) one-hot labels (10 real + 6 pad lanes)
     w_c1_ref,     # (6, 25)
     b_c1_ref,     # (6, 1)
@@ -604,17 +612,18 @@ def _fused_kernel(
     dot = functools.partial(
         lax.dot_general,
         preferred_element_type=f32,
-        precision=lax.Precision.HIGHEST,
+        precision=lax.Precision.DEFAULT,
     )
 
     # Forward: conv (25 tap-FMAs/filter) → pool (Mp matmul) → FC.
+    bb = y1h_ref.shape[0]
     outs_c1 = []
     outs_s1 = []
-    pre_f = jnp.broadcast_to(b_f_ref[:], (x25_ref.shape[0], 10))
+    pre_f = jnp.broadcast_to(b_f_ref[:], (bb, 10))
     for m in range(6):
-        acc = jnp.full(x25_ref.shape[:1] + (576,), b_c1_ref[m, 0], f32)
+        acc = jnp.full((bb, 576), b_c1_ref[m, 0], f32)
         for t in range(25):
-            acc += w_c1_ref[m, t] * x25_ref[:, t, :]
+            acc += w_c1_ref[m, t] * x25_ref[t]
         out_m = _sigmoid(acc)                                   # (Bb, 576)
         outs_c1.append(out_m)
         pre_s1_m = dot(out_m, mp, (((1,), (0,)), ((), ()))) + b_s1_ref[0, 0]
@@ -653,7 +662,7 @@ def _fused_kernel(
         for t in range(25):
             r = m * 25 + t
             gwc1_ref[r : r + 1, :] += jnp.sum(
-                d_pre_c1_m * x25_ref[:, t, :], axis=0, keepdims=True
+                d_pre_c1_m * x25_ref[t], axis=0, keepdims=True
             )
 
 
@@ -674,7 +683,7 @@ def _fused_call(x25, y1h, params, n_pad: int):
         _fused_kernel,
         grid=(n_pad // bb,),
         in_specs=[
-            pl.BlockSpec((bb, 25, 576), lambda g: (g, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((25, bb, 576), lambda g: (0, g, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((bb, 16), lambda g: (g, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((6, 25), lambda g: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((6, 1), lambda g: (0, 0), memory_space=pltpu.VMEM),
@@ -738,11 +747,16 @@ def fused_value_and_ref_grads(
         xs = jnp.concatenate([xs, jnp.zeros((pad,) + xs.shape[1:], xs.dtype)])
     n_pad = n + pad
 
-    # Host-side prep (cheap XLA relayouts): im2col the input once — tap
-    # t = 5p+q on the sublane dim, flat pixel uv on the lane dim.
-    x25 = lax.conv_general_dilated_patches(
-        xs[:, None].astype(f32), (5, 5), (1, 1), "VALID"
-    ).reshape(n_pad, 25, 576)
+    # Host-side prep (cheap XLA relayouts): im2col the input once, in the
+    # TAP-MAJOR (25, B, 576) layout the kernel wants — tap t = 5p+q leads,
+    # flat pixel uv on the lane dim.
+    x25 = (
+        lax.conv_general_dilated_patches(
+            xs[:, None].astype(f32), (5, 5), (1, 1), "VALID"
+        )
+        .reshape(n_pad, 25, 576)
+        .transpose(1, 0, 2)
+    )
     # One-hot labels padded to 16 lanes; lane 10 doubles as the pad-sample
     # mask (1 for real rows, 0 for pad rows — zeroing d_pre_f and with it
     # every grad & err contribution of the pad).

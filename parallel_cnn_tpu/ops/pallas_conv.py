@@ -1,0 +1,269 @@
+"""Pallas conv kernels for the model zoo (BASELINE.json config #4:
+"ResNet-18 on CIFAR-10 with Pallas conv kernels").
+
+≙ the CUDA backend's hand-written conv kernels (CUDA/layer.cu:116-130)
+generalized beyond the fixed LeNet shapes: a TPU-native conv as
+**shift-and-matmul** — NHWC with channels on the lane axis, the conv's
+9 (or 1) taps each ONE large MXU matmul over a row-shifted view of the
+spatially-padded, flattened input:
+
+    out_flat[r, :] = Σ_t  in_flat[r + off_t, :] @ W_t        (C × Cout)
+
+where `in_flat` is (B·Hp·Wp, C) (Hp=H+2 zero-padded for 3×3 SAME) and
+off_t = (dy−1)·Wp + (dx−1). Rows within `margin` of an image boundary
+compute garbage that lands only on pad rows, which the wrapper slices
+away — so every tap is a dense, unstrided slice + matmul, the shape
+Mosaic and the MXU want (no im2col materialization, no gather).
+
+The same kernel body serves all three conv derivatives:
+- forward:  taps over x, weights W_t (C, Cout)
+- dgrad:    taps over dout with NEGATED offsets, weights W_tᵀ (Cout, C)
+- wgrad:    per-tap  x_shiftᵀ @ dout  (C, Cout), accumulated across the
+            batch grid into a (T, C, Cout) block (≙ the CUDA atomicAdd
+            weight-grad trees, without atomics: the TPU grid is
+            sequential)
+
+wired together with `jax.custom_vjp`, so `jax.grad` through the zoo
+trainer uses Pallas for every conv FLOP.
+
+Scope (documented, enforced): kernel 3×3 or 1×1, stride 1 or 2, SAME
+padding, NHWC. Stride 2 computes the stride-1 output and subsamples —
+~15% extra FLOPs on ResNet-18's three downsample convs, traded for one
+kernel shape. Everything else falls back to XLA (`nn.layers.Conv2D`
+keeps backend="xla" as default).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+# Shared with the LeNet kernel library: compile-vs-interpret keys off the
+# axon-aware TPU detection, and batch blocks must divide the batch.
+from parallel_cnn_tpu.ops.pallas import _batch_block, _interpret  # noqa: E402
+
+
+# Per-block VMEM budget for choosing how many images ride one grid step
+# (input + output + pipeline double-buffering, with headroom under the
+# raised scoped limit — see ops/pallas.py FUSED_VMEM_LIMIT rationale).
+_VMEM_BUDGET = 24 * 1024 * 1024
+_VMEM_LIMIT = 64 * 1024 * 1024
+
+
+def _fwd_kernel(offsets, margin, x_ref, w_ref, o_ref):
+    """o[r] = Σ_t x[r+off_t] @ w[t] for center rows; margin rows zeroed."""
+    nb = o_ref.shape[0]
+    lo, hi = margin, nb - margin
+    acc = None
+    for t, off in enumerate(offsets):
+        part = lax.dot_general(
+            x_ref[lo + off : hi + off, :],
+            w_ref[t],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc = part if acc is None else acc + part
+    o_ref[lo:hi, :] = acc.astype(o_ref.dtype)
+    if margin:
+        o_ref[:lo, :] = jnp.zeros((lo,) + o_ref.shape[1:], o_ref.dtype)
+        o_ref[hi:, :] = jnp.zeros((nb - hi,) + o_ref.shape[1:], o_ref.dtype)
+
+
+def _wgrad_kernel(offsets, margin, x_ref, g_ref, gw_ref):
+    """gw[t] += x[center+off_t]ᵀ @ g[center], accumulated across the grid.
+
+    Pad rows of g are zero (the wrapper embeds dout with zero pad), so
+    their contributions vanish without masking.
+    """
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        gw_ref[:] = jnp.zeros_like(gw_ref)
+
+    nb = g_ref.shape[0]
+    lo, hi = margin, nb - margin
+    g = g_ref[lo:hi, :]
+    for t, off in enumerate(offsets):
+        gw_ref[t] += lax.dot_general(
+            x_ref[lo + off : hi + off, :],
+            g,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(gw_ref.dtype)
+
+
+def _tap_offsets(k: int, wp: int):
+    if k == 1:
+        return (0,), 0
+    assert k == 3
+    offs = tuple(
+        (dy - 1) * wp + (dx - 1) for dy in range(3) for dx in range(3)
+    )
+    return offs, wp + 1  # margin ≥ max |offset|
+
+
+def _pad_nhwc(x: jax.Array, k: int) -> jax.Array:
+    if k == 1:
+        return x
+    return jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+
+
+def _pick_bb(n: int, rows: int, cin: int, cout: int) -> int:
+    per_img = rows * (cin + cout) * 4 * 2  # f32, double-buffered in+out
+    return _batch_block(n, max(1, _VMEM_BUDGET // per_img))
+
+
+def _tapped_matmul(x_flat, w_taps, rows_per_img, offsets, margin, out_ch):
+    """(B·rows, Cin) × (T, Cin, Cout) → (B·rows, Cout) over a batch grid."""
+    n = x_flat.shape[0] // rows_per_img
+    cin = x_flat.shape[1]
+    bb = _pick_bb(n, rows_per_img, cin, out_ch)
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, offsets, margin),
+        grid=(n // bb,),
+        in_specs=[
+            pl.BlockSpec(
+                (bb * rows_per_img, cin), lambda g: (g, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                w_taps.shape, lambda g: (0, 0, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (bb * rows_per_img, out_ch), lambda g: (g, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((n * rows_per_img, out_ch), x_flat.dtype),
+        interpret=_interpret(),
+        compiler_params=None if _interpret() else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT
+        ),
+    )(x_flat, w_taps)
+
+
+def _tapped_wgrad(x_flat, g_flat, rows_per_img, offsets, margin):
+    n = x_flat.shape[0] // rows_per_img
+    cin, cout = x_flat.shape[1], g_flat.shape[1]
+    t = len(offsets)
+    bb = _pick_bb(n, rows_per_img, cin, cout)
+    return pl.pallas_call(
+        functools.partial(_wgrad_kernel, offsets, margin),
+        grid=(n // bb,),
+        in_specs=[
+            pl.BlockSpec(
+                (bb * rows_per_img, cin), lambda g: (g, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (bb * rows_per_img, cout), lambda g: (g, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (t, cin, cout), lambda g: (0, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((t, cin, cout), jnp.float32),
+        interpret=_interpret(),
+        compiler_params=None if _interpret() else pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_LIMIT
+        ),
+    )(x_flat, g_flat)
+
+
+def _conv_s1(x: jax.Array, w: jax.Array) -> jax.Array:
+    """Stride-1 SAME conv, NHWC · HWIO → NHWC, k ∈ {1, 3}."""
+    b, h, wd, cin = x.shape
+    k = w.shape[0]
+    cout = w.shape[3]
+    xp = _pad_nhwc(x, k)
+    hp, wp = xp.shape[1], xp.shape[2]
+    offsets, margin = _tap_offsets(k, wp)
+    x_flat = xp.reshape(b * hp * wp, cin)
+    w_taps = w.reshape(k * k, cin, cout).astype(x.dtype)
+    o_flat = _tapped_matmul(x_flat, w_taps, hp * wp, offsets, margin, cout)
+    o = o_flat.reshape(b, hp, wp, cout)
+    if k == 3:
+        o = o[:, 1 : hp - 1, 1 : wp - 1, :]
+    return o
+
+
+def _s2_offsets(h: int, w: int, k: int) -> Tuple[int, int]:
+    """Subsample phase matching XLA's SAME stride-2 window placement.
+
+    XLA splits SAME padding as pad_lo = pad_total // 2; for k=3 an
+    even-sized dim gets pad_total=1 → pad_lo=0, so output o is centered
+    at 2o+1 — phase 1 of the (symmetrically padded) stride-1 output. Odd
+    dims (and all k=1 cases) get phase 0.
+    """
+    if k == 1:
+        return 0, 0
+    return (1 if h % 2 == 0 else 0), (1 if w % 2 == 0 else 0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def conv2d(x: jax.Array, w: jax.Array, stride: int = 1) -> jax.Array:
+    """SAME conv via the Pallas tapped-matmul kernel; stride ∈ {1, 2}
+    (stride 2 subsamples the stride-1 output at XLA's window phase)."""
+    o = _conv_s1(x, w)
+    if stride == 2:
+        oy, ox = _s2_offsets(x.shape[1], x.shape[2], w.shape[0])
+        o = o[:, oy::2, ox::2, :]
+    return o
+
+
+def _conv2d_fwd(x, w, stride):
+    return conv2d(x, w, stride), (x, w)
+
+
+def _conv2d_bwd(stride, res, g):
+    x, w = res
+    b, h, wd, cin = x.shape
+    k = w.shape[0]
+    cout = w.shape[3]
+    if stride == 2:
+        # scatter dout back onto the stride-1 grid at the forward's phase
+        oy, ox = _s2_offsets(h, wd, k)
+        gfull = jnp.zeros((b, h, wd, cout), g.dtype)
+        g = gfull.at[:, oy::2, ox::2, :].set(g)
+    # Shared padded-flat geometry for both grads; dout pad rows are ZERO,
+    # so pad contributions vanish in each contraction.
+    gp = _pad_nhwc(g, k)
+    hp, wp = gp.shape[1], gp.shape[2]
+    offsets, margin = _tap_offsets(k, wp)
+    g_flat = gp.reshape(b * hp * wp, cout)
+
+    # dgrad: dx[r] = Σ_t dout[r − off_t] @ w_tᵀ — same kernel, negated
+    # offsets, transposed taps.
+    wt = (
+        w.reshape(k * k, cin, cout).transpose(0, 2, 1).astype(g.dtype)
+    )  # (T, Cout, Cin)
+    neg = tuple(-o for o in offsets)
+    dx_flat = _tapped_matmul(g_flat, wt, hp * wp, neg, margin, cin)
+    dx = dx_flat.reshape(b, hp, wp, cin)
+    if k == 3:
+        dx = dx[:, 1 : hp - 1, 1 : wp - 1, :]
+
+    # wgrad: per-tap xᵀ @ dout accumulated over the batch grid.
+    xp = _pad_nhwc(x, k)
+    x_flat = xp.reshape(b * hp * wp, cin)
+    gw = _tapped_wgrad(x_flat, g_flat, hp * wp, offsets, margin)
+    return dx.astype(x.dtype), gw.reshape(k, k, cin, cout).astype(w.dtype)
+
+
+conv2d.defvjp(_conv2d_fwd, _conv2d_bwd)
+
+
+def supports(kernel: Tuple[int, int], strides: Tuple[int, int], padding: str) -> bool:
+    """Shapes this kernel library covers; Conv2D falls back to XLA otherwise."""
+    return (
+        kernel in ((1, 1), (3, 3))
+        and strides in ((1, 1), (2, 2))
+        and padding == "SAME"
+    )

@@ -1,0 +1,107 @@
+"""Differential tests: zoo Pallas conv kernels (ops/pallas_conv.py) vs
+XLA `lax.conv_general_dilated` — forward, dgrad, and wgrad, plus the full
+ResNet-18 pallas-backend train step (BASELINE.json config #4). Interpret
+mode on the CPU harness; the same code compiles via Mosaic on TPU
+(benchmarked by bench.py's zoo rows)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+from parallel_cnn_tpu.ops import pallas_conv
+
+
+def _ref(x, w, s):
+    return lax.conv_general_dilated(
+        x, w, (s, s), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+
+
+CASES = [
+    (2, 8, 8, 4, 8, 3, 1),
+    (2, 8, 8, 4, 8, 3, 2),   # even dims: XLA phase-1 subsample alignment
+    (2, 7, 9, 4, 8, 3, 2),   # odd/mixed dims: phase 0/1 per axis
+    (3, 8, 8, 4, 8, 1, 1),
+    (2, 8, 8, 4, 8, 1, 2),
+    (2, 5, 7, 3, 5, 3, 1),   # non-tile-friendly spatial dims
+]
+
+
+@pytest.mark.parametrize("b,h,w,cin,cout,k,s", CASES)
+def test_conv2d_matches_xla(b, h, w, cin, cout, k, s):
+    rng = np.random.default_rng(b * h + k + s)
+    x = jnp.asarray(rng.standard_normal((b, h, w, cin)).astype(np.float32))
+    wt = jnp.asarray(
+        rng.standard_normal((k, k, cin, cout)).astype(np.float32) * 0.1
+    )
+    ref = _ref(x, wt, s)
+    got = pallas_conv.conv2d(x, wt, s)
+    assert got.shape == ref.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
+
+
+@pytest.mark.parametrize("b,h,w,cin,cout,k,s", CASES)
+def test_conv2d_grads_match_xla(b, h, w, cin, cout, k, s):
+    """custom_vjp (Pallas dgrad + wgrad kernels) vs XLA autodiff through a
+    nonlinearity, so every output element's cotangent is distinct."""
+    rng = np.random.default_rng(b + h * w + k)
+    x = jnp.asarray(rng.standard_normal((b, h, w, cin)).astype(np.float32))
+    wt = jnp.asarray(
+        rng.standard_normal((k, k, cin, cout)).astype(np.float32) * 0.1
+    )
+    gx_r, gw_r = jax.grad(
+        lambda x, w: jnp.sum(jnp.sin(_ref(x, w, s))), argnums=(0, 1)
+    )(x, wt)
+    gx_g, gw_g = jax.grad(
+        lambda x, w: jnp.sum(jnp.sin(pallas_conv.conv2d(x, w, s))),
+        argnums=(0, 1),
+    )(x, wt)
+    np.testing.assert_allclose(np.asarray(gx_g), np.asarray(gx_r), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gw_g), np.asarray(gw_r), atol=1e-4)
+
+
+def test_supports_surface():
+    assert pallas_conv.supports((3, 3), (1, 1), "SAME")
+    assert pallas_conv.supports((1, 1), (2, 2), "SAME")
+    assert not pallas_conv.supports((7, 7), (2, 2), "SAME")
+    assert not pallas_conv.supports((3, 3), (1, 1), "VALID")
+
+
+def test_conv2d_unsupported_shape_raises():
+    from parallel_cnn_tpu.nn.layers import Conv2D
+
+    layer = Conv2D(8, kernel=(7, 7), strides=(2, 2), backend="pallas")
+    params, state, _ = layer.init(jax.random.key(0), (16, 16, 3))
+    with pytest.raises(ValueError, match="pallas conv backend"):
+        layer.apply(params, state, jnp.zeros((1, 16, 16, 3)))
+
+
+def test_resnet18_pallas_backend_step_matches_xla():
+    """One zoo train step of ResNet-18 with EVERY conv on the Pallas
+    kernels must track the XLA-backend step (same init, same data)."""
+    from parallel_cnn_tpu.nn import cifar, resnet
+    from parallel_cnn_tpu.train import zoo
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.uniform(0, 1, (8,) + cifar.IN_SHAPE).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 10, (8,)).astype(np.int32))
+    opt = zoo.make_optimizer(0.05)
+
+    losses = {}
+    params = {}
+    for backend in ("xla", "pallas"):
+        m = resnet.resnet18(10, cifar_stem=True, conv_backend=backend)
+        st = zoo.init_state(m, jax.random.key(0), cifar.IN_SHAPE, opt)
+        st, loss = zoo.make_train_step(m, opt)(st, x, y)
+        losses[backend] = float(loss)
+        params[backend] = st.params
+
+    assert abs(losses["xla"] - losses["pallas"]) < 1e-5
+    for a, b in zip(
+        jax.tree_util.tree_leaves(params["xla"]),
+        jax.tree_util.tree_leaves(params["pallas"]),
+        strict=True,
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
