@@ -25,13 +25,30 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native trainer with the reference's capabilities",
     )
     d, t = DataConfig(), TrainConfig()
+    p.add_argument("--model", default="lenet_ref",
+                   choices=["lenet_ref", "cifar_cnn", "resnet18", "resnet34",
+                            "resnet50"],
+                   help="lenet_ref = the reference-parity trainer; the rest "
+                        "route to the model-zoo trainer (train/zoo.py, "
+                        "synthetic CIFAR-shape data, SGD+momentum)")
+    p.add_argument("--conv-backend", default="xla",
+                   choices=["xla", "pallas"],
+                   help="zoo models only: conv kernel library — XLA convs "
+                        "or the hand-written Pallas tapped-matmul kernels "
+                        "(ops/pallas_conv.py)")
+    p.add_argument("--lr", type=float, default=0.1,
+                   help="zoo models only: SGD learning rate")
+    p.add_argument("--accum-steps", type=int, default=1,
+                   help="zoo models only: gradient-accumulation microbatches")
     p.add_argument("--loader", default=d.loader,
                    choices=["auto", "native", "numpy", "synthetic"])
     p.add_argument("--data-dir", default=None,
                    help="directory holding the four idx files "
                         "(defaults to the DataConfig paths)")
     p.add_argument("--epochs", type=int, default=t.epochs)
-    p.add_argument("--batch-size", type=int, default=t.batch_size)
+    # None sentinel: lenet_ref defaults to the strict-parity batch_size=1,
+    # zoo models to minibatch 128 — an EXPLICIT value is never reinterpreted.
+    p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--dt", type=float, default=t.dt,
                    help="SGD step (dt at Sequential/layer.h:12)")
     p.add_argument("--threshold", type=float, default=t.threshold,
@@ -91,7 +108,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         dt=args.dt,
         threshold=args.threshold,
         epochs=args.epochs,
-        batch_size=args.batch_size,
+        batch_size=args.batch_size if args.batch_size is not None else 1,
         seed=args.seed,
         shuffle=args.shuffle,
         prefetch=args.prefetch,
@@ -103,7 +120,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
     # override — no jax import may happen here). A bare `--mesh-model 1`
     # is the single-device default and does not activate the mesh.
     mesh = MeshConfig(data=args.mesh_data, model=args.mesh_model or 1)
-    return Config(data=data, train=train, mesh=mesh)
+    return Config(data=data, train=train, mesh=mesh, model=args.model)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -138,6 +155,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     from parallel_cnn_tpu.utils import profiling
 
     distributed.initialize()  # env-configured multi-host; no-op otherwise
+
+    if cfg.model != "lenet_ref":
+        return _run_zoo(args, cfg)
     train_ds, test_ds = pipeline.load_train_test(cfg.data)
 
     params = None
@@ -199,6 +219,84 @@ def main(argv: Optional[List[str]] = None) -> int:
         phases = profiling.profile_phases(result.params, xs, ys)
         print(profiling.report(phases, n_images=xs.shape[0]))
 
+    return 0
+
+
+def _run_zoo(args: argparse.Namespace, cfg: Config) -> int:
+    """Zoo-model driver branch (--model {cifar_cnn,resnet18,34,50}).
+
+    Trains on the deterministic synthetic CIFAR-shape stand-in (this
+    environment cannot fetch CIFAR/ImageNet — BASELINE.md), with the
+    production surface zoo.train provides: per-epoch eval, atomic
+    checkpoint/resume of the FULL state, JSONL metrics, GSPMD DP over a
+    --mesh-data mesh, and --conv-backend pallas for the native kernels.
+    """
+    from parallel_cnn_tpu.data import synthetic
+    from parallel_cnn_tpu.nn import cifar, resnet
+    from parallel_cnn_tpu.parallel import mesh as mesh_lib
+    from parallel_cnn_tpu.train import zoo
+    from parallel_cnn_tpu.utils.metrics import MetricsLogger
+
+    factories = {
+        "cifar_cnn": lambda: cifar.cifar_cnn(),
+        "resnet18": lambda: resnet.resnet18(
+            10, cifar_stem=True, conv_backend=args.conv_backend
+        ),
+        "resnet34": lambda: resnet.resnet34(
+            10, cifar_stem=True, conv_backend=args.conv_backend
+        ),
+        "resnet50": lambda: resnet.resnet50(
+            10, cifar_stem=True, conv_backend=args.conv_backend
+        ),
+    }
+    if cfg.model == "cifar_cnn" and args.conv_backend != "xla":
+        raise SystemExit("--conv-backend pallas applies to the resnet models")
+    if args.mesh_model not in (None, 1):
+        raise SystemExit(
+            "zoo models parallelize via GSPMD data parallelism only "
+            "(--mesh-data); --mesh-model is the lenet_ref intra-op path"
+        )
+    model = factories[cfg.model]()
+
+    imgs, labels = synthetic.make_image_dataset(
+        args.synthetic_train_count, seed=cfg.data.synthetic_seed
+    )
+    ev_imgs, ev_labels = synthetic.make_image_dataset(
+        args.synthetic_test_count, seed=cfg.data.synthetic_seed + 1
+    )
+
+    mesh = None
+    if args.mesh_data is not None:
+        mesh = mesh_lib.make_mesh(MeshConfig(data=args.mesh_data, model=1))
+        print(f"mesh: {dict(mesh.shape)}")
+
+    metrics = MetricsLogger(path=args.metrics) if args.metrics else None
+    # batch-size sentinel: zoo default is minibatch 128; an explicit 1 is
+    # a config error (per-sample SGD is the lenet_ref parity mode).
+    if args.batch_size is None:
+        batch = 128
+    elif args.batch_size == 1:
+        raise SystemExit("zoo models train minibatch; use --batch-size > 1")
+    else:
+        batch = args.batch_size
+    zoo.train(
+        model,
+        imgs,
+        labels,
+        in_shape=cifar.IN_SHAPE,
+        epochs=args.epochs,
+        batch_size=batch,
+        lr=args.lr,
+        accum_steps=args.accum_steps,
+        mesh=mesh,
+        seed=args.seed,
+        eval_data=(ev_imgs, ev_labels),
+        checkpoint_dir=args.checkpoint_dir,
+        resume=args.resume,
+        metrics=metrics,
+    )
+    if metrics:
+        metrics.close()
     return 0
 
 

@@ -137,6 +137,29 @@ def test_cli_end_to_end_with_checkpoint_resume(tmp_path):
 
 
 @pytest.mark.slow
+def test_cli_zoo_model(tmp_path):
+    """--model routes to the zoo trainer (train/zoo.py) with per-epoch
+    eval, checkpointing, and metrics — the Config.model field as a real
+    driver surface."""
+    ckpt = str(tmp_path / "zck")
+    metrics = str(tmp_path / "zm.jsonl")
+    r = _run_cli([
+        "--model", "cifar_cnn",
+        "--epochs", "1",
+        "--batch-size", "64",
+        "--synthetic-train-count", "256",
+        "--synthetic-test-count", "64",
+        "--checkpoint-dir", ckpt,
+        "--metrics", metrics,
+    ])
+    assert r.returncode == 0, r.stderr
+    assert "epoch 1: loss" in r.stdout and "acc" in r.stdout
+    assert checkpoint.latest(ckpt) is not None
+    recs = [json.loads(l) for l in open(metrics)]
+    assert any(rec.get("event") == "zoo_epoch" for rec in recs)
+
+
+@pytest.mark.slow
 def test_cli_mesh_training(tmp_path):
     """--mesh-data/--mesh-model drive learn() over the 8-device CPU mesh
     from a real subprocess (≙ mpirun launching MPI/Main.cpp:43-53) and
