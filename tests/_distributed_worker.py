@@ -13,8 +13,12 @@ tests/conftest.py), joins the coordination service, and runs:
    (MPI/Main.cpp:43-112) and round 2's smoke test stopped short of
    (VERDICT r2 weak #5). The parent asserts the loss trajectory matches
    the single-process run bit-for-bit-to-tolerance.
+3. The same three steps on a hybrid 2-D (data, model) mesh whose MODEL
+   axis is interleaved ACROSS the two processes — every activation and
+   shared-kernel-grad psum is a cross-process collective.
 
-Prints parseable RESULT / TRAIN lines for the parent to assert on.
+Prints parseable RESULT / TRAIN / TRAIN2D lines for the parent to assert
+on.
 """
 
 import os
@@ -38,6 +42,20 @@ TRAIN_STEPS = 3
 GLOBAL_BATCH = 16
 
 
+def _globalize(mesh, a, sharding):
+    host = np.asarray(a)
+    return jax.make_array_from_callback(
+        host.shape, sharding, lambda idx: host[idx]
+    )
+
+
+def _train_data():
+    rng = np.random.default_rng(123)
+    xs = rng.uniform(0, 1, (TRAIN_STEPS, GLOBAL_BATCH, 28, 28)).astype(np.float32)
+    ys = rng.integers(0, 10, (TRAIN_STEPS, GLOBAL_BATCH)).astype(np.int32)
+    return xs, ys
+
+
 def train_trajectory():
     """Three DP train steps over the GLOBAL mesh (every process's devices).
 
@@ -55,24 +73,59 @@ def train_trajectory():
     rep = NamedSharding(mesh, P())
     dat = NamedSharding(mesh, P("data"))
 
-    def globalize(a, sharding):
-        host = np.asarray(a)
-        return jax.make_array_from_callback(
-            host.shape, sharding, lambda idx: host[idx]
-        )
-
     params = jax.tree_util.tree_map(
-        lambda a: globalize(a, rep), lenet_ref.init(jax.random.key(7))
+        lambda a: _globalize(mesh, a, rep), lenet_ref.init(jax.random.key(7))
     )
-    rng = np.random.default_rng(123)
-    xs = rng.uniform(0, 1, (TRAIN_STEPS, GLOBAL_BATCH, 28, 28)).astype(np.float32)
-    ys = rng.integers(0, 10, (TRAIN_STEPS, GLOBAL_BATCH)).astype(np.int32)
+    xs, ys = _train_data()
 
     step = data_parallel.make_dp_step(mesh, dt=0.1, global_batch=GLOBAL_BATCH)
     errs = []
     for i in range(TRAIN_STEPS):
-        params, e = step(params, globalize(xs[i], dat), globalize(ys[i], dat))
+        params, e = step(
+            params, _globalize(mesh, xs[i], dat), _globalize(mesh, ys[i], dat)
+        )
         errs.append(float(e))  # replicated output: addressable on every rank
+    return errs
+
+
+def train_trajectory_2d():
+    """The same three steps on a 2-D (data, model) mesh whose MODEL axis
+    crosses the process boundary — every forward's activation psum and
+    every shared-kernel grad psum is a real cross-process collective
+    (strictly stronger than the reference's intra-box MPI runs,
+    MPI/Main.cpp:43-112)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from parallel_cnn_tpu.config import MeshConfig
+    from parallel_cnn_tpu.models import lenet_ref
+    from parallel_cnn_tpu.parallel import intra_op, mesh as mesh_lib
+
+    devices = jax.devices()
+    n = len(devices)
+    # Interleave the two processes' devices so each (data-row) model PAIR
+    # spans both processes — the default order would keep model pairs
+    # process-local and the claim above would be hollow.
+    half = n // 2
+    interleaved = [d for pair in zip(devices[:half], devices[half:]) for d in pair]
+    assert {p.process_index for p in interleaved[:2]} == {0, 1}
+    mesh = mesh_lib.make_mesh(MeshConfig(data=n // 2, model=2), devices=interleaved)
+    dat = NamedSharding(mesh, P("data"))
+    shardings = intra_op.param_shardings(mesh)
+
+    params = jax.tree_util.tree_map(
+        lambda a, s: _globalize(mesh, a, s),
+        lenet_ref.init(jax.random.key(7)),
+        shardings,
+    )
+    xs, ys = _train_data()
+
+    step = intra_op.make_2d_step(mesh, dt=0.1, global_batch=GLOBAL_BATCH)
+    errs = []
+    for i in range(TRAIN_STEPS):
+        params, e = step(
+            params, _globalize(mesh, xs[i], dat), _globalize(mesh, ys[i], dat)
+        )
+        errs.append(float(e))
     return errs
 
 
@@ -96,6 +149,9 @@ def main() -> int:
 
     errs = train_trajectory()
     print("TRAIN", ",".join(f"{e:.8e}" for e in errs), flush=True)
+
+    errs2d = train_trajectory_2d()
+    print("TRAIN2D", ",".join(f"{e:.8e}" for e in errs2d), flush=True)
     return 0
 
 

@@ -258,7 +258,7 @@ def test_two_process_distributed_smoke(tmp_path):
 
     trains = []
     for out in outs:
-        line = [l for l in out.splitlines() if l.startswith("TRAIN")][0]
+        line = [l for l in out.splitlines() if l.split()[:1] == ["TRAIN"]][0]
         trains.append([float(v) for v in line.split()[1].split(",")])
     assert trains[0] == trains[1], "ranks diverged (the reference's bug B7)"
     assert len(trains[0]) == n, "worker TRAIN_STEPS drifted from the test's"
@@ -277,3 +277,13 @@ def test_two_process_distributed_smoke(tmp_path):
         )
         ref_errs.append(float(e))
     np.testing.assert_allclose(trains[0], ref_errs, rtol=1e-5)
+
+    # Hybrid 2-D mesh with the MODEL axis spanning the two processes:
+    # activation/grad psums are genuine cross-process collectives, and the
+    # trajectory must still match the single-device batched run.
+    trains2d = []
+    for out in outs:
+        line = [l for l in out.splitlines() if l.startswith("TRAIN2D")][0]
+        trains2d.append([float(v) for v in line.split()[1].split(",")])
+    assert trains2d[0] == trains2d[1]
+    np.testing.assert_allclose(trains2d[0], ref_errs, rtol=1e-4)
