@@ -62,6 +62,39 @@ def test_conv2d_grads_match_xla(b, h, w, cin, cout, k, s):
     np.testing.assert_allclose(np.asarray(gw_g), np.asarray(gw_r), atol=1e-4)
 
 
+def test_conv2d_bf16_compute():
+    """bf16 inputs (the TPU bench's zoo dtype): f32 MXU accumulation,
+    output back in bf16, grads still usable — pin the dtype plumbing the
+    compiled path relies on."""
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.standard_normal((2, 8, 8, 4)).astype(np.float32))
+    wt = jnp.asarray(rng.standard_normal((3, 3, 4, 8)).astype(np.float32) * 0.1)
+    out = pallas_conv.conv2d(x.astype(jnp.bfloat16), wt.astype(jnp.bfloat16), 1)
+    assert out.dtype == jnp.bfloat16
+    ref = _ref(x, wt, 1)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), atol=0.05
+    )
+    # Non-uniform cotangents (sin) so bf16 dgrad/wgrad VALUES are pinned
+    # against the f32 XLA reference, not just dtypes/finiteness.
+    gx, gw = jax.grad(
+        lambda x, w: jnp.sum(
+            jnp.sin(pallas_conv.conv2d(x, w, 1).astype(jnp.float32))
+        ),
+        argnums=(0, 1),
+    )(x.astype(jnp.bfloat16), wt.astype(jnp.bfloat16))
+    assert gx.dtype == jnp.bfloat16 and gw.dtype == jnp.bfloat16
+    gx_r, gw_r = jax.grad(
+        lambda x, w: jnp.sum(jnp.sin(_ref(x, w, 1))), argnums=(0, 1)
+    )(x, wt)
+    np.testing.assert_allclose(
+        np.asarray(gx, np.float32), np.asarray(gx_r), atol=0.05
+    )
+    np.testing.assert_allclose(
+        np.asarray(gw, np.float32), np.asarray(gw_r), atol=0.3
+    )
+
+
 def test_supports_surface():
     assert pallas_conv.supports((3, 3), (1, 1), "SAME")
     assert pallas_conv.supports((1, 1), (2, 2), "SAME")

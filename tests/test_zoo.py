@@ -83,6 +83,37 @@ def test_cifar_cnn_learns_synthetic():
     assert correct > 128  # way above the 10% chance floor
 
 
+def test_zoo_bf16_compute_trains():
+    """bf16 inputs drive bf16 compute through every nn layer (params cast
+    to x.dtype in apply; f32 BatchNorm stats, f32 loss) — the zoo's mixed-
+    precision mode, the dtype the TPU bench's MXU rows run in."""
+    imgs, labels = synthetic.make_image_dataset(256, seed=4)
+    model = cifar.cifar_cnn()
+    # lr 0.01: repeated steps on one batch with momentum diverge at 0.05
+    # in f32 and bf16 alike — this test pins dtype behavior, not tuning.
+    opt = zoo.make_optimizer(0.01)
+    st = zoo.init_state(model, jax.random.key(0), cifar.IN_SHAPE, opt)
+    step = zoo.make_train_step(model, opt)
+    x = jnp.asarray(imgs[:128]).astype(jnp.bfloat16)
+    y = jnp.asarray(labels[:128])
+    losses = []
+    for _ in range(4):
+        st, loss = step(st, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    # bf16 compute actually happened: the network's outputs are bf16
+    logits, _ = model.apply(st.params, st.model_state, x, train=False)
+    assert logits.dtype == jnp.bfloat16
+    # master weights AND BatchNorm running stats stay f32
+    assert all(
+        l.dtype == jnp.float32 for l in jax.tree_util.tree_leaves(st.params)
+    )
+    assert all(
+        l.dtype == jnp.float32
+        for l in jax.tree_util.tree_leaves(st.model_state)
+    )
+
+
 def test_grad_accumulation_matches_full_batch():
     """accum_steps=4 must produce the same update as one full batch (BN
     stats aside — compare params only, loss to tolerance)."""
