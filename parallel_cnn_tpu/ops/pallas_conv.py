@@ -50,11 +50,16 @@ from jax.experimental.pallas import tpu as pltpu
 from parallel_cnn_tpu.ops.pallas import _batch_block, _interpret  # noqa: E402
 
 
-# Per-block VMEM budget for choosing how many images ride one grid step
-# (input + output + pipeline double-buffering, with headroom under the
-# raised scoped limit — see ops/pallas.py FUSED_VMEM_LIMIT rationale).
+# Per-block VMEM budget for choosing how many images ride one grid step.
+# The block's true scoped footprint is NOT just the double-buffered in/out
+# pipeline buffers: Mosaic materializes each of the T unrolled tap slices
+# (a (rows−2·margin, Cin) copy per tap) plus the f32 accumulator, and on
+# v5e that stack is what OOMs first (measured: the 8×8 256→512 3×3 conv
+# at bb=32 wants 71.6 MB of scoped vmem). _pick_bb models all of it; the
+# scoped limit is raised toward the chip's 128 MB with headroom for the
+# pipeline's own double buffering.
 _VMEM_BUDGET = 24 * 1024 * 1024
-_VMEM_LIMIT = 64 * 1024 * 1024
+_VMEM_LIMIT = 100 * 1024 * 1024
 
 
 def _fwd_kernel(offsets, margin, x_ref, w_ref, o_ref):
@@ -114,8 +119,10 @@ def _pad_nhwc(x: jax.Array, k: int) -> jax.Array:
     return jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
 
 
-def _pick_bb(n: int, rows: int, cin: int, cout: int) -> int:
-    per_img = rows * (cin + cout) * 4 * 2  # f32, double-buffered in+out
+def _pick_bb(n: int, rows: int, cin: int, cout: int, taps: int) -> int:
+    # f32 bytes/image: double-buffered in+out pipeline blocks, T tap-slice
+    # copies, accumulator + per-tap dot result (see _VMEM_BUDGET note).
+    per_img = rows * 4 * (2 * (cin + cout) + taps * cin + 2 * cout)
     return _batch_block(n, max(1, _VMEM_BUDGET // per_img))
 
 
@@ -123,7 +130,7 @@ def _tapped_matmul(x_flat, w_taps, rows_per_img, offsets, margin, out_ch):
     """(B·rows, Cin) × (T, Cin, Cout) → (B·rows, Cout) over a batch grid."""
     n = x_flat.shape[0] // rows_per_img
     cin = x_flat.shape[1]
-    bb = _pick_bb(n, rows_per_img, cin, out_ch)
+    bb = _pick_bb(n, rows_per_img, cin, out_ch, len(offsets))
     return pl.pallas_call(
         functools.partial(_fwd_kernel, offsets, margin),
         grid=(n // bb,),
@@ -152,7 +159,7 @@ def _tapped_wgrad(x_flat, g_flat, rows_per_img, offsets, margin):
     n = x_flat.shape[0] // rows_per_img
     cin, cout = x_flat.shape[1], g_flat.shape[1]
     t = len(offsets)
-    bb = _pick_bb(n, rows_per_img, cin, cout)
+    bb = _pick_bb(n, rows_per_img, cin, cout, t)
     return pl.pallas_call(
         functools.partial(_wgrad_kernel, offsets, margin),
         grid=(n // bb,),
