@@ -13,17 +13,23 @@ subprocess with a hard timeout; if the default (TPU) backend is unreachable
 the run falls back to CPU and the line is labeled `"platform": "cpu"`.
 `PCNN_JAX_PLATFORMS` overrides the platform outright (as in cli.py).
 
-Method: the throughput-mode trainer (minibatch reference-contract grads,
-train/step.py:batched_step semantics) compiled as ONE jitted lax.scan over
-the whole epoch — no host round-trips, timed with block_until_ready
-(contrast: the reference's CUDA timings never sync, SURVEY.md B11).
+Method: the minibatch reference-contract epoch (train/step.py:batched_step
+semantics) compiled as ONE jitted lax.scan over the whole epoch — no host
+round-trips, timed with block_until_ready (contrast: the reference's CUDA
+timings never sync, SURVEY.md B11) — measured on BOTH op paths on TPU (or
+with PCNN_BENCH_PALLAS set; the CPU fallback times path A only). `value`
+is the fastest full-contract path: the XLA ops (path A), or the fused
+Pallas megakernel (path B) when it wins and its on-chip grad diff vs
+path A is within tolerance; `path` labels which won, `xla_img_per_sec` /
+`pallas_img_per_sec` carry the raw numbers of whatever was measured.
 
 Also reported (extra keys, same line):
 - `mfu`: analytic model FLOPs × images/sec over chip peak (the judge's
   single-chip grading axis; the reference has no analog).
-- `pallas_img_per_sec`: same epoch on the Pallas kernel path (path B) —
-  a COMPILED Mosaic run when platform is TPU, proving the hand-written
-  kernels build and quantifying them vs path A.
+- `pallas_max_abs_diff`: on-chip path-A-vs-B grad parity on one batch
+  (compiled-Mosaic numerics evidence, docs/kernel_authoring.md rule 5).
+- `bf16_*` and `zoo_resnet18_*`: the bf16 mixed-precision row and the
+  MXU-saturation rows (ResNet-18 CIFAR, XLA and Pallas-conv backends).
 """
 
 from __future__ import annotations
@@ -272,6 +278,23 @@ def main() -> None:
             except Exception as e:
                 zoo_pallasconv_img_per_sec = f"error: {type(e).__name__}: {e}"[:200]
 
+    # Headline = the framework's fastest full-contract path. The fused
+    # Pallas megakernel (path B) carries the same reference numerics as
+    # path A — the same-line pallas_max_abs_diff is the on-chip evidence —
+    # so when it wins AND its grads match within tolerance, it IS the
+    # flagship number (exactly how the reference crowns CUDA its headline
+    # backend, README.md:17-18). Both raw paths stay in the line.
+    xla_img_per_sec = img_per_sec
+    path = "xla"
+    if (
+        isinstance(pallas_img_per_sec, (int, float))
+        and isinstance(pallas_max_abs_diff, float)
+        and pallas_max_abs_diff <= 1e-2
+        and pallas_img_per_sec > img_per_sec
+    ):
+        img_per_sec = pallas_img_per_sec
+        path = "pallas_fused"
+
     # MFU on TPU by default (v5e peaks, dtype-matched), or on any platform
     # when the user supplies their chip's peak via PCNN_PEAK_FLOPS*.
     any_peak_supplied = _PEAK_OVERRIDE or any(
@@ -290,8 +313,10 @@ def main() -> None:
                 "unit": "images/sec/chip",
                 "vs_baseline": round(img_per_sec / CUDA_BASELINE_IMG_PER_SEC, 2),
                 "platform": platform,
+                "path": path,
                 "mfu": mfu,
                 "flops_per_image": FLOPS_PER_IMAGE,
+                "xla_img_per_sec": round(xla_img_per_sec, 1),
                 "pallas_img_per_sec": pallas_img_per_sec,
                 "pallas_max_abs_diff": pallas_max_abs_diff,
                 "bf16_img_per_sec": bf16_img_per_sec,

@@ -50,15 +50,20 @@ from jax.experimental.pallas import tpu as pltpu
 from parallel_cnn_tpu.ops.pallas import _batch_block, _interpret  # noqa: E402
 
 
-# Per-block VMEM budget for choosing how many images ride one grid step.
-# The block's true scoped footprint is NOT just the double-buffered in/out
+# Scoped-VMEM model for choosing how many images ride one grid step.
+# The block's true footprint is NOT just the double-buffered in/out
 # pipeline buffers: Mosaic materializes each of the T unrolled tap slices
 # (a (rows−2·margin, Cin) copy per tap) plus the f32 accumulator, and on
-# v5e that stack is what OOMs first (measured: the 8×8 256→512 3×3 conv
-# at bb=32 wants 71.6 MB of scoped vmem). _pick_bb models all of it; the
-# scoped limit is raised toward the chip's 128 MB with headroom for the
-# pipeline's own double buffering.
-_VMEM_BUDGET = 24 * 1024 * 1024
+# v5e that stack is what OOMs first. The model below reproduces the
+# compiler's own accounting to within ~1% (measured: the 8×8 256→512 3×3
+# conv at bb=32 reports 71.59 MB scoped = 1.95 MB/img × 32 + the
+# double-buffered 9.4 MB tap-weight block). Blocks are sized against a
+# MODERATE budget, not the whole limit: measured on the chip, ResNet-18
+# pallas-conv throughput is identical at bb=8 and bb=32 (6898 vs 6899
+# img/s — the per-tap matmuls are already MXU-sized) while Mosaic compile
+# time grows with block bytes, so big blocks only buy slower builds. The
+# raised limit stays as safety margin over the model.
+_VMEM_BUDGET = 32 * 1024 * 1024
 _VMEM_LIMIT = 100 * 1024 * 1024
 
 
@@ -119,18 +124,27 @@ def _pad_nhwc(x: jax.Array, k: int) -> jax.Array:
     return jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
 
 
-def _pick_bb(n: int, rows: int, cin: int, cout: int, taps: int) -> int:
-    # f32 bytes/image: double-buffered in+out pipeline blocks, T tap-slice
-    # copies, accumulator + per-tap dot result (see _VMEM_BUDGET note).
-    per_img = rows * 4 * (2 * (cin + cout) + taps * cin + 2 * cout)
-    return _batch_block(n, max(1, _VMEM_BUDGET // per_img))
+def _pick_bb(
+    n: int, rows: int, cin: int, cout: int, taps: int, esz: int, w_esz: int
+) -> int:
+    # Bytes/image: double-buffered in+out pipeline blocks and T tap-slice
+    # copies at the input element size (esz — bf16 halves them),
+    # accumulator + per-tap dot result always f32. The (T, Cin, Cout)
+    # block is batch-independent but double-buffered; its element size
+    # differs per kernel — the fwd/dgrad tap-weight INPUT is at the input
+    # dtype, the wgrad accumulator OUTPUT is always f32 (w_esz).
+    per_img = rows * (esz * (2 * (cin + cout) + taps * cin) + 4 * 2 * cout)
+    w_bytes = 2 * taps * cin * cout * w_esz
+    avail = _VMEM_BUDGET - w_bytes
+    return _batch_block(n, max(1, avail // per_img))
 
 
 def _tapped_matmul(x_flat, w_taps, rows_per_img, offsets, margin, out_ch):
     """(B·rows, Cin) × (T, Cin, Cout) → (B·rows, Cout) over a batch grid."""
     n = x_flat.shape[0] // rows_per_img
     cin = x_flat.shape[1]
-    bb = _pick_bb(n, rows_per_img, cin, out_ch, len(offsets))
+    esz = x_flat.dtype.itemsize
+    bb = _pick_bb(n, rows_per_img, cin, out_ch, len(offsets), esz, esz)
     return pl.pallas_call(
         functools.partial(_fwd_kernel, offsets, margin),
         grid=(n // bb,),
@@ -159,7 +173,7 @@ def _tapped_wgrad(x_flat, g_flat, rows_per_img, offsets, margin):
     n = x_flat.shape[0] // rows_per_img
     cin, cout = x_flat.shape[1], g_flat.shape[1]
     t = len(offsets)
-    bb = _pick_bb(n, rows_per_img, cin, cout, t)
+    bb = _pick_bb(n, rows_per_img, cin, cout, t, x_flat.dtype.itemsize, 4)
     return pl.pallas_call(
         functools.partial(_wgrad_kernel, offsets, margin),
         grid=(n // bb,),
