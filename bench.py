@@ -20,7 +20,7 @@ timings never sync, SURVEY.md B11) — measured on BOTH op paths on TPU (or
 with PCNN_BENCH_PALLAS set; the CPU fallback times path A only). `value`
 is the fastest full-contract path: the XLA ops (path A), or the fused
 Pallas megakernel (path B) when it wins and its on-chip grad diff vs
-path A is within tolerance; `path` labels which won, `xla_img_per_sec` /
+path A is within PALLAS_PARITY_TOL; `path` labels which won, `xla_img_per_sec` /
 `pallas_img_per_sec` carry the raw numbers of whatever was measured.
 
 Also reported (extra keys, same line):
@@ -69,6 +69,18 @@ TPU_PEAK_F32 = float(_PEAK_OVERRIDE or os.environ.get("PCNN_PEAK_FLOPS_F32", 98.
 # stages 2-4 each 134.2M incl. downsample 1×1; fc 512·10) = 555,422,720 MACs,
 # ×2 FLOP/MAC ×3 for fwd+bwd (bwd ≈ 2× fwd, the standard accounting).
 RESNET18_TRAIN_FLOPS_PER_IMAGE = 2 * 3 * 555_422_720
+
+# Zoo-row batch sizes (both labeled in the JSON line): 1024 is the MFU
+# knee for the XLA-conv row (39%/49%/51% at 512/1024/2048); the
+# Pallas-conv row stays at 512 to bound its ~40 Mosaic kernel compiles
+# (throughput there is block-size-insensitive).
+ZOO_BATCH = 1024
+ZOO_PALLAS_BATCH = 512
+
+# Max on-chip |grad_A − grad_B| admitted before the fused Pallas path is
+# barred from the headline (docs/bench_results.md states this rule; keep
+# them in sync). Measured diff is ~4e-4 — pure f32 reassociation.
+PALLAS_PARITY_TOL = 1e-2
 
 
 def _resolve_platform() -> str:
@@ -255,25 +267,30 @@ def main() -> None:
             bf16_img_per_sec = f"error: {type(e).__name__}: {e}"[:200]
 
     # The MXU-saturation row (VERDICT r2 next #2): ResNet-18 (cifar_stem)
-    # bf16 training throughput + analytic-FLOPs MFU at batch 512 — LeNet's
-    # 379-kFLOP graph can't exercise the MXU; this is the number a TPU
-    # framework's ceiling is judged on.
+    # bf16 training throughput + analytic-FLOPs MFU — LeNet's 379-kFLOP
+    # graph can't exercise the MXU; this is the number a TPU framework's
+    # ceiling is judged on. Batch 1024: measured 39%/49%/51% MFU at
+    # 512/1024/2048 — 1024 captures the knee without 2048's memory and
+    # compile cost.
     zoo_img_per_sec = None
     zoo_mfu = None
     zoo_pallasconv_img_per_sec = None
     if platform == "tpu" or os.environ.get("PCNN_BENCH_ZOO"):
         try:
-            zoo_img_per_sec, zoo_mfu = _bench_resnet18()
+            zoo_img_per_sec, zoo_mfu = _bench_resnet18(batch=ZOO_BATCH)
         except Exception as e:  # labeled, not fatal
             zoo_img_per_sec = f"error: {type(e).__name__}: {e}"[:200]
         # Config #4's native-kernel cell: the same ResNet-18 with EVERY
         # conv routed through the Pallas tapped-matmul kernels
         # (ops/pallas_conv.py) instead of XLA's convs. Compiled Mosaic
-        # only — interpret mode at batch 512 is hours on CPU.
+        # only — interpret mode at this scale is hours on CPU. Batch 512
+        # (not 1024): ~40 Mosaic kernel compiles dominate this row's cost
+        # and throughput is block-size-insensitive (ops/pallas_conv.py
+        # _VMEM_BUDGET note), so the smaller labeled batch bounds it.
         if platform == "tpu":
             try:
                 zoo_pallasconv_img_per_sec, _ = _bench_resnet18(
-                    conv_backend="pallas"
+                    conv_backend="pallas", batch=ZOO_PALLAS_BATCH
                 )
             except Exception as e:
                 zoo_pallasconv_img_per_sec = f"error: {type(e).__name__}: {e}"[:200]
@@ -289,7 +306,7 @@ def main() -> None:
     if (
         isinstance(pallas_img_per_sec, (int, float))
         and isinstance(pallas_max_abs_diff, float)
-        and pallas_max_abs_diff <= 1e-2
+        and pallas_max_abs_diff <= PALLAS_PARITY_TOL
         and pallas_img_per_sec > img_per_sec
     ):
         img_per_sec = pallas_img_per_sec
@@ -322,14 +339,16 @@ def main() -> None:
                 "bf16_img_per_sec": bf16_img_per_sec,
                 "zoo_resnet18_bf16_img_per_sec": zoo_img_per_sec,
                 "zoo_resnet18_bf16_mfu": zoo_mfu,
+                "zoo_resnet18_batch": ZOO_BATCH,
                 "zoo_resnet18_pallasconv_bf16_img_per_sec": zoo_pallasconv_img_per_sec,
+                "zoo_resnet18_pallasconv_batch": ZOO_PALLAS_BATCH,
             }
         )
     )
 
 
-def _bench_resnet18(conv_backend: str = "xla"):
-    """(images/sec, MFU) for resnet18(cifar_stem) bf16 training, batch 512.
+def _bench_resnet18(conv_backend: str = "xla", batch: int = 1024):
+    """(images/sec, MFU) for resnet18(cifar_stem) bf16 training.
 
     ≙ the paper's "entire network" row (PDF Table 8) at a scale that can
     saturate the MXU. bf16 compute via input dtype (nn layers follow
@@ -343,7 +362,6 @@ def _bench_resnet18(conv_backend: str = "xla"):
     from parallel_cnn_tpu.nn import cifar, resnet
     from parallel_cnn_tpu.train import zoo
 
-    batch = 512
     steps = 10
     rng = np.random.default_rng(2)
     x = jnp.asarray(
