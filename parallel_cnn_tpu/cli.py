@@ -38,6 +38,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "(ops/pallas_conv.py)")
     p.add_argument("--lr", type=float, default=0.1,
                    help="zoo models only: SGD learning rate")
+    p.add_argument("--lr-schedule", default="constant",
+                   choices=["constant", "cosine"],
+                   help="zoo models only: cosine decays over the full run "
+                        "(epochs x steps); both honor --warmup-steps")
+    p.add_argument("--warmup-steps", type=int, default=0,
+                   help="zoo models only: linear LR warmup steps")
+    p.add_argument("--augment", action="store_true",
+                   help="zoo models only: on-device random crop + "
+                        "horizontal flip (CIFAR recipe), traced into the "
+                        "train step")
     p.add_argument("--accum-steps", type=int, default=1,
                    help="zoo models only: gradient-accumulation microbatches")
     p.add_argument("--loader", default=d.loader,
@@ -287,6 +297,9 @@ def _run_zoo(args: argparse.Namespace, cfg: Config) -> int:
         epochs=args.epochs,
         batch_size=batch,
         lr=args.lr,
+        lr_schedule=args.lr_schedule,
+        warmup_steps=args.warmup_steps,
+        augment=args.augment,
         accum_steps=args.accum_steps,
         mesh=mesh,
         seed=args.seed,

@@ -1,0 +1,37 @@
+"""Device-side image augmentation for the zoo trainer (CIFAR-style
+random crop + horizontal flip).
+
+TPU-native by construction: the whole transform is traced into the jitted
+train step — vectorized `dynamic_slice` crops and a masked mirror, driven
+by a `jax.random` key threaded per step — so augmentation runs on-chip as
+part of the step program, never as a host-side preprocessing pass (the
+reference has no augmentation at all; its loader hands samples straight
+to the kernels, Sequential/Main.cpp:36-42).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def random_crop_flip(key: jax.Array, x: jax.Array, pad: int = 4) -> jax.Array:
+    """Pad-and-random-crop by `pad` pixels plus 50% horizontal mirror.
+
+    x is NHWC; shape and dtype are preserved. The standard CIFAR recipe:
+    zero-pad each side by `pad`, take a random H×W window per image, then
+    mirror half the images. `pad=0` degenerates to flip-only.
+    """
+    b, h, w, c = x.shape
+    kc, kf = jax.random.split(key)
+    if pad:
+        xp = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+        offs = jax.random.randint(kc, (b, 2), 0, 2 * pad + 1)
+
+        def crop(img, off):
+            return lax.dynamic_slice(img, (off[0], off[1], 0), (h, w, c))
+
+        x = jax.vmap(crop)(xp, offs)
+    flip = jax.random.bernoulli(kf, 0.5, (b,))
+    return jnp.where(flip[:, None, None, None], x[:, :, ::-1, :], x)

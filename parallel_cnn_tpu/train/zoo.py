@@ -50,8 +50,35 @@ def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
 
 
 def make_optimizer(
-    lr: float = 0.1, momentum: float = 0.9, weight_decay: float = 0.0
+    lr: float = 0.1,
+    momentum: float = 0.9,
+    weight_decay: float = 0.0,
+    schedule: str = "constant",
+    warmup_steps: int = 0,
+    total_steps: Optional[int] = None,
 ) -> optax.GradientTransformation:
+    """SGD(+momentum, +decoupled weight decay) with an LR schedule.
+
+    schedule: "constant" (optional linear warmup over `warmup_steps`) or
+    "cosine" (linear warmup then cosine decay to 0 over `total_steps` —
+    required for cosine, since the decay horizon must be known at trace
+    time; the step count lives in the optimizer state, so it checkpoints
+    and resumes with the rest of ZooState).
+    """
+    if schedule == "cosine":
+        if not total_steps:
+            raise ValueError("schedule='cosine' needs total_steps")
+        lr = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=lr,
+            warmup_steps=warmup_steps,
+            decay_steps=total_steps,
+        )
+    elif schedule == "constant":
+        if warmup_steps:
+            lr = optax.linear_schedule(0.0, lr, warmup_steps)
+    else:
+        raise ValueError(f"unknown schedule {schedule!r}")
     txs = []
     if weight_decay:
         txs.append(optax.add_decayed_weights(weight_decay))
@@ -74,13 +101,19 @@ def make_train_step(
     optimizer: optax.GradientTransformation,
     accum_steps: int = 1,
     mesh: Optional[Mesh] = None,
+    augment: Optional[Callable] = None,
 ) -> Callable:
-    """Build the jitted train step: (state, x, y) -> (state, loss).
+    """Build the jitted train step: (state, x, y) -> (state, loss), or
+    (state, x, y, key) -> (state, loss) when `augment` is given.
 
     accum_steps > 1 splits the batch into microbatches scanned inside the
     step (one optimizer update per call — effective batch preserved, peak
     activation memory divided). With a mesh, x/y are constrained to the
     ``data`` axis and params to replicated — GSPMD handles the rest.
+    `augment` is a traced (key, x) -> x transform (data/augment.py) that
+    runs on-device inside the same jitted program, after the sharding
+    constraint — so under a mesh each device augments only its own batch
+    shard.
     """
 
     def loss_fn(params, model_state, x, y):
@@ -118,7 +151,7 @@ def make_train_step(
         grads = jax.tree_util.tree_map(lambda g: g / accum_steps, gsum)
         return lsum / accum_steps, model_state, grads
 
-    def step(state: ZooState, x, y):
+    def step(state: ZooState, x, y, key=None):
         if mesh is not None:
             data_sh = NamedSharding(mesh, P(DATA_AXIS))
             x = jax.lax.with_sharding_constraint(x, data_sh)
@@ -134,6 +167,8 @@ def make_train_step(
                 state.model_state,
                 state.opt_state,
             )
+        if augment is not None:
+            x = augment(key, x)
         loss, model_state, grads = microbatch_grads(
             state.params, state.model_state, x, y
         )
@@ -192,6 +227,10 @@ def train(
     lr: float = 0.1,
     momentum: float = 0.9,
     weight_decay: float = 0.0,
+    lr_schedule: str = "constant",
+    warmup_steps: int = 0,
+    augment: bool = False,
+    augment_pad: int = 4,
     accum_steps: int = 1,
     mesh: Optional[Mesh] = None,
     seed: int = 0,
@@ -215,12 +254,37 @@ def train(
       (kill-and-resume tested in tests/test_zoo.py).
     - ``eval_data=(images, labels)``: in-loop accuracy after each epoch.
     - ``metrics``: a utils.metrics.MetricsLogger; per-epoch records.
+    - ``lr_schedule``/``warmup_steps``: make_optimizer's schedule knobs;
+      the cosine horizon is the full run (epochs × steps-per-epoch), and
+      the schedule's step count rides in opt_state, so resume continues
+      the decay where the killed run stopped.
+    - ``augment=True``: CIFAR-recipe random crop (±``augment_pad``) +
+      horizontal flip, traced into the train step (data/augment.py);
+      per-step keys derive from ``seed`` and the global step index, so
+      the augmentation stream is also resume-reproducible.
 
     Returns (ZooState, list of per-epoch mean losses).
     """
-    optimizer = make_optimizer(lr, momentum, weight_decay)
+    steps = images.shape[0] // batch_size
+    if steps == 0:
+        raise ValueError(
+            f"dataset of {images.shape[0]} samples yields zero batches "
+            f"of {batch_size}"
+        )
+    optimizer = make_optimizer(
+        lr, momentum, weight_decay,
+        schedule=lr_schedule, warmup_steps=warmup_steps,
+        total_steps=steps * epochs if lr_schedule == "cosine" else None,
+    )
     state = init_state(model, jax.random.key(seed), in_shape, optimizer)
-    step = make_train_step(model, optimizer, accum_steps, mesh)
+    aug_fn = None
+    if augment:
+        from parallel_cnn_tpu.data import augment as aug_lib
+
+        def aug_fn(key, x):
+            return aug_lib.random_crop_flip(key, x, pad=augment_pad)
+
+    step = make_train_step(model, optimizer, accum_steps, mesh, aug_fn)
     ev_step = make_eval_step(model) if eval_data is not None else None
 
     start_epoch = 0
@@ -241,13 +305,9 @@ def train(
                 print(f"resumed from {path} (epoch {start_epoch})")
 
     n = images.shape[0]
-    steps = n // batch_size
-    if steps == 0:
-        raise ValueError(
-            f"dataset of {n} samples yields zero batches of {batch_size}"
-        )
     images = jnp.asarray(images)
     labels = jnp.asarray(labels)
+    aug_base = jax.random.key(seed ^ 0x5EED)
     for epoch in range(start_epoch, epochs):
         perm = jax.random.permutation(jax.random.key(seed + epoch), n)
         t0 = time.perf_counter()
@@ -257,7 +317,12 @@ def train(
         epoch_loss = jnp.float32(0.0)
         for i in range(steps):
             idx = perm[i * batch_size : (i + 1) * batch_size]
-            state, loss = step(state, images[idx], labels[idx])
+            key = (
+                jax.random.fold_in(aug_base, epoch * steps + i)
+                if aug_fn is not None
+                else None
+            )
+            state, loss = step(state, images[idx], labels[idx], key)
             epoch_loss = epoch_loss + loss
         losses.append(float(epoch_loss) / max(steps, 1))
         seconds = time.perf_counter() - t0

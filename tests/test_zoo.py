@@ -227,3 +227,146 @@ def test_resnet18_kill_and_resume_matches_continuous(tmp_path):
     assert all(r["event"] == "zoo_epoch" for r in recs)
     assert all("accuracy" in r and "loss" in r for r in recs)
     assert [r["epoch"] for r in recs] == [1, 2]
+
+
+def test_augment_random_crop_flip_contract():
+    """Shape/dtype preserved; keyed determinism; pad=0 is flip-only (every
+    output is the input or its mirror); crops are translations of the
+    zero-padded input (probed via a coordinate-ramp image)."""
+    from parallel_cnn_tpu.data import augment
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.uniform(size=(8, 16, 16, 3)).astype(np.float32))
+    k = jax.random.key(7)
+
+    out = augment.random_crop_flip(k, x, pad=2)
+    assert out.shape == x.shape and out.dtype == x.dtype
+    # same key -> identical; different key -> different
+    assert np.array_equal(np.asarray(out), np.asarray(augment.random_crop_flip(k, x, pad=2)))
+    assert not np.array_equal(
+        np.asarray(out), np.asarray(augment.random_crop_flip(jax.random.key(8), x, pad=2))
+    )
+
+    # pad=0: flip-only — each image is itself or its horizontal mirror
+    f = np.asarray(augment.random_crop_flip(k, x, pad=0))
+    xn = np.asarray(x)
+    for i in range(xn.shape[0]):
+        assert np.array_equal(f[i], xn[i]) or np.array_equal(f[i], xn[i, :, ::-1, :])
+
+    # crop geometry: a ramp image's interior values shift by integer
+    # offsets in [-pad, pad] (un-mirroring first if needed)
+    ramp = jnp.broadcast_to(
+        (jnp.arange(16)[:, None, None] * 100 + jnp.arange(16)[None, :, None]).astype(jnp.float32),
+        (4, 16, 16, 1),
+    )
+    c = np.asarray(augment.random_crop_flip(jax.random.key(3), ramp, pad=2))
+    for i in range(4):
+        img = c[i, :, :, 0]
+        rimg = np.asarray(ramp)[i, :, :, 0]
+        candidates = [img, img[:, ::-1]]
+        ok = False
+        for cand in candidates:
+            # interior pixel (8,8) encodes its source coordinate
+            v = cand[8, 8]
+            dy, dx = int(v // 100) - 8, int(v % 100) - 8
+            if abs(dy) <= 2 and abs(dx) <= 2:
+                src = np.zeros((20, 20))
+                src[2:18, 2:18] = rimg
+                win = src[2 + dy : 18 + dy, 2 + dx : 18 + dx]
+                if np.array_equal(cand, win):
+                    ok = True
+                    break
+        assert ok, f"image {i} is not a crop/flip of the padded input"
+
+
+def test_zoo_trains_with_augmentation_and_cosine_schedule():
+    """The production-trainer combo: on-device crop+flip augmentation and
+    warmup+cosine LR — trains end-to-end and still learns."""
+    imgs, labels = synthetic.make_image_dataset(256, seed=5)
+    state, losses = zoo.train(
+        cifar.cifar_cnn(),
+        imgs,
+        labels,
+        in_shape=cifar.IN_SHAPE,
+        epochs=3,
+        batch_size=64,
+        lr=0.05,
+        lr_schedule="cosine",
+        warmup_steps=2,
+        augment=True,
+        verbose=False,
+    )
+    assert losses[-1] < losses[0], losses
+
+
+def test_make_optimizer_schedules_shape_the_updates():
+    """Warmup makes the first update smaller than the post-warmup one;
+    cosine makes the final update smaller than the peak one."""
+    params = {"w": jnp.ones((4,))}
+    g = {"w": jnp.full((4,), 0.5)}
+
+    def update_norms(opt, n):
+        st = opt.init(params)
+        norms = []
+        for _ in range(n):
+            up, st = opt.update(g, st, params)
+            norms.append(float(jnp.linalg.norm(up["w"])))
+        return norms
+
+    warm = update_norms(zoo.make_optimizer(0.1, momentum=0.0, warmup_steps=4), 6)
+    assert warm[0] < warm[5] and warm[5] == pytest.approx(0.1 * 0.5 * 2, rel=1e-5)
+
+    cos = update_norms(
+        zoo.make_optimizer(0.1, momentum=0.0, schedule="cosine", warmup_steps=2, total_steps=10), 10
+    )
+    assert max(cos) == pytest.approx(max(cos[:4]))  # peak near warmup end
+    assert cos[-1] < max(cos) * 0.2  # decayed
+
+    with pytest.raises(ValueError):
+        zoo.make_optimizer(0.1, schedule="cosine")
+    with pytest.raises(ValueError):
+        zoo.make_optimizer(0.1, schedule="nope")
+
+
+def test_resume_continues_cosine_schedule_and_augment_stream(tmp_path):
+    """The docstring's resume guarantees, pinned: the cosine schedule's
+    step count rides in opt_state and the augmentation keys derive from
+    (seed, global step), so a run resumed from the epoch-1 checkpoint must
+    reproduce the uninterrupted run's epoch 2 exactly. The kill is
+    simulated by deleting the epoch-2 checkpoint and resuming from the
+    epoch-1 one — same `epochs` both times, so the schedule horizon
+    matches a genuinely killed run (unlike training with fewer epochs,
+    which would build a shorter cosine horizon)."""
+    import os
+
+    imgs, labels = synthetic.make_image_dataset(128, seed=6)
+    model = resnet.resnet18(10, cifar_stem=True)
+    ckpt = str(tmp_path / "sched_ckpts")
+    kw = dict(
+        in_shape=cifar.IN_SHAPE,
+        epochs=2,
+        batch_size=32,
+        lr=0.05,
+        lr_schedule="cosine",
+        warmup_steps=2,
+        augment=True,
+        seed=11,
+        verbose=False,
+        checkpoint_dir=ckpt,
+    )
+
+    continuous, c_losses = zoo.train(model, imgs, labels, **kw)
+
+    os.remove(os.path.join(ckpt, "ckpt_2.npz"))  # "killed" during epoch 2
+    resumed, r_losses = zoo.train(model, imgs, labels, resume=True, **kw)
+
+    assert len(r_losses) == 2
+    np.testing.assert_allclose(r_losses, c_losses, rtol=1e-4)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(continuous),
+        jax.tree_util.tree_leaves(resumed),
+        strict=True,
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4
+        )
