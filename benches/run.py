@@ -65,17 +65,78 @@ class Row:
         return self
 
 
+_drain_cache: dict = {}
+
+
+def _drain(tree) -> None:
+    """TRUE execution barrier for a pytree through the tunneled chip.
+
+    Neither block_until_ready nor a single-leaf readback is enough there:
+    block_until_ready can return while compile + execution are still in
+    flight, and one leaf can complete long before the rest of the program
+    (measured: reading only ZooState's first leaf — an optimizer count
+    that increments without touching the heavy compute — timed ResNet-50
+    @224² at a physically impossible 33 ms/step). So: jit a scalar that
+    consumes EVERY leaf and read that scalar back — the one host readback
+    cannot materialize until the whole program has run."""
+    leaves = [l for l in jax.tree_util.tree_leaves(tree) if hasattr(l, "dtype")]
+    key = tuple((l.shape, str(l.dtype)) for l in leaves)
+    fn = _drain_cache.get(key)
+    if fn is None:
+        def _reduce(*ls):
+            tot = jnp.float32(0.0)
+            for l in ls:
+                tot = tot + jnp.sum(jnp.abs(l.astype(jnp.float32)))
+            return tot
+
+        fn = jax.jit(_reduce)
+        _drain_cache[key] = fn
+    np.asarray(fn(*leaves))
+
+
+_tiny_chain = jax.jit(lambda v: v + 1.0)
+
+
+def _rtt() -> float:
+    """Min-of-3 readback RTT on a trivial chained program (min, not mean:
+    RTT jitter only ever ADDS latency, so the smallest sample is the
+    least-biased estimate of the floor being subtracted)."""
+    v = _tiny_chain(jnp.float32(0.0))
+    np.asarray(v)
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        v = _tiny_chain(v)
+        np.asarray(v)
+        samples.append(time.perf_counter() - t0)
+    return min(samples)
+
+
 def _sync_time(thunk, repeats: int) -> float:
-    """Chained-dispatch timing with one host readback (relay-safe)."""
+    """Chained-dispatch timing: warmup drained, `repeats` chained calls,
+    one full drain, minus the measured readback RTT (as bench.py does —
+    the RTT otherwise dominates short rows through the relay, e.g.
+    cifar_cnn's ~6 ms/step of compute under a ~100 ms readback)."""
     out = thunk(None)
-    jax.block_until_ready(out)
+    _drain(out)
     carry = out
     t0 = time.perf_counter()
     for _ in range(repeats):
         carry = thunk(carry)
-    jax.block_until_ready(carry)
-    np.asarray(jax.tree_util.tree_leaves(carry)[0])  # host readback barrier
-    return (time.perf_counter() - t0) / repeats
+    _drain(carry)
+    elapsed = time.perf_counter() - t0
+    corrected = elapsed - _rtt()
+    if corrected <= 0:
+        # Fail loudly: a clamped near-zero denominator would report absurd
+        # throughput as if it were a legitimate measurement — the silent-
+        # garbage class this harness exists to avoid. Raise so the row is
+        # an error, and tell the caller the cure (more chained repeats).
+        raise RuntimeError(
+            f"timed region ({elapsed * 1e3:.1f} ms over {repeats} repeats) "
+            "did not exceed the readback RTT; raise `repeats` so compute "
+            "dominates the RTT"
+        )
+    return corrected / repeats
 
 
 def bench_lenet_throughput(quick: bool) -> List[Row]:
@@ -365,10 +426,13 @@ def bench_zoo(quick: bool) -> List[Row]:
     batch = 256 if quick else 512
     imgs, labels = synthetic.make_image_dataset(batch, seed=1)
     x, y = jnp.asarray(imgs), jnp.asarray(labels)
+    # Per-case timed repeats: scale inversely with step cost so cheap rows
+    # amortize the relay readback RTT (cifar_cnn ~6 ms/step needs many
+    # chained steps; ResNet-50 @224² ~0.5 s/step needs few).
     cases = [
-        ("cifar_cnn", cifar.cifar_cnn(), cifar.IN_SHAPE, x, y, 1),
+        ("cifar_cnn", cifar.cifar_cnn(), cifar.IN_SHAPE, x, y, 1, 50),
         ("resnet18_cifar", resnet.resnet18(10, cifar_stem=True),
-         cifar.IN_SHAPE, x, y, 1),
+         cifar.IN_SHAPE, x, y, 1, 20),
     ]
     from parallel_cnn_tpu.utils.backend import canonical_platform
 
@@ -378,7 +442,7 @@ def bench_zoo(quick: bool) -> List[Row]:
         cases.append(
             ("resnet18_cifar_pallasconv",
              resnet.resnet18(10, cifar_stem=True, conv_backend="pallas"),
-             cifar.IN_SHAPE, x, y, 1)
+             cifar.IN_SHAPE, x, y, 1, 10)
         )
     # Config #5: ResNet-50 at ImageNet shape (synthetic stand-in — no
     # egress, BASELINE.md), microbatched via grad accumulation so the
@@ -392,9 +456,9 @@ def bench_zoo(quick: bool) -> List[Row]:
     )
     cases.append(
         ("resnet50_imagenet_accum4", resnet.resnet50(100, cifar_stem=False),
-         in50, jnp.asarray(imgs50), jnp.asarray(labels50), 4)
+         in50, jnp.asarray(imgs50), jnp.asarray(labels50), 4, 5)
     )
-    for name, model, in_shape, bx, by, accum in cases:
+    for name, model, in_shape, bx, by, accum, reps in cases:
         bsz = bx.shape[0]
         opt = zoo.make_optimizer(0.05)
         st = zoo.init_state(model, jax.random.key(0), in_shape, opt)
@@ -404,7 +468,7 @@ def bench_zoo(quick: bool) -> List[Row]:
             s = carry[0] if carry is not None else st
             return step(s, bx, by)
 
-        sec = _sync_time(thunk, repeats=2 if quick else 5)
+        sec = _sync_time(thunk, repeats=2 if quick else reps)
         rows.append(
             Row(f"zoo_{name}_train", round(bsz / sec, 1), "images/sec").finish()
         )

@@ -152,6 +152,11 @@ def make_train_step(
         return lsum / accum_steps, model_state, grads
 
     def step(state: ZooState, x, y, key=None):
+        if augment is not None and key is None:
+            raise ValueError(
+                "this train step was built with `augment`; call it as "
+                "step(state, x, y, key) with a fresh PRNG key per step"
+            )
         if mesh is not None:
             data_sh = NamedSharding(mesh, P(DATA_AXIS))
             x = jax.lax.with_sharding_constraint(x, data_sh)
