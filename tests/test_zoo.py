@@ -30,6 +30,8 @@ def test_layer_shapes():
     [
         # torchvision resnet18 (ImageNet stem, 1000 classes): 11,689,512
         (lambda: resnet.resnet18(1000, cifar_stem=False), (64, 64, 3), 11_689_512),
+        # torchvision resnet34 (1000 classes): 21,797,672
+        (lambda: resnet.resnet34(1000, cifar_stem=False), (64, 64, 3), 21_797_672),
         # torchvision resnet50 (1000 classes): 25,557,032
         (lambda: resnet.resnet50(1000), (64, 64, 3), 25_557_032),
     ],
@@ -370,3 +372,26 @@ def test_resume_continues_cosine_schedule_and_augment_stream(tmp_path):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4
         )
+
+
+def test_zoo_augment_composes_with_dp_mesh():
+    """Augmentation is traced inside the GSPMD-sharded step, so it must
+    run with the batch sharded over the data axis (each device augments
+    its own shard) — the composition cell behind make_train_step's
+    docstring claim."""
+    imgs, labels = synthetic.make_image_dataset(256, seed=7)
+    mesh = mesh_lib.make_mesh(MeshConfig(data=4, model=1))
+    state, losses = zoo.train(
+        cifar.cifar_cnn(),
+        imgs,
+        labels,
+        in_shape=cifar.IN_SHAPE,
+        epochs=2,
+        batch_size=64,
+        lr=0.05,
+        augment=True,
+        mesh=mesh,
+        verbose=False,
+    )
+    assert len(losses) == 2 and all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0], losses
