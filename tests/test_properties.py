@@ -1,0 +1,111 @@
+"""Property-based tests (hypothesis) for the framework's pure contracts:
+the idx-ubyte parser (C1's format surface), the augmentation geometry,
+and the kernel-library block-sizing invariants the Pallas grids rely on."""
+
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from parallel_cnn_tpu.data import mnist
+from parallel_cnn_tpu.data.augment import random_crop_flip
+from parallel_cnn_tpu.ops.pallas import _batch_block
+from parallel_cnn_tpu.ops import pallas_conv as pc
+
+
+def _idx3_bytes(images: np.ndarray) -> bytes:
+    n, h, w = images.shape
+    return struct.pack(">iiii", 2051, n, h, w) + images.tobytes()
+
+
+def _idx1_bytes(labels: np.ndarray) -> bytes:
+    return struct.pack(">ii", 2049, labels.shape[0]) + labels.tobytes()
+
+
+@settings(max_examples=25, deadline=None)
+@given(
+    n=st.integers(1, 8),
+    data=st.data(),
+)
+def test_idx_roundtrip_arbitrary_pixels(tmp_path_factory, n, data):
+    """Any 28x28 uint8 payload roundtrips: count preserved, pixels /255
+    in [0,1], labels byte-exact — the mnist.h:100-149 contract."""
+    rng = np.random.default_rng(data.draw(st.integers(0, 2**32 - 1)))
+    imgs = rng.integers(0, 256, (n, 28, 28), dtype=np.uint8)
+    labs = rng.integers(0, 10, (n,), dtype=np.uint8)
+    d = tmp_path_factory.mktemp("idx")
+    ip, lp = str(d / "im.idx3"), str(d / "la.idx1")
+    open(ip, "wb").write(_idx3_bytes(imgs))
+    open(lp, "wb").write(_idx1_bytes(labs))
+
+    out = mnist.load_idx_images(ip)
+    assert out.shape == (n, 28, 28) and out.dtype == np.float32
+    np.testing.assert_allclose(out, imgs.astype(np.float32) / 255.0)
+    np.testing.assert_array_equal(mnist.load_idx_labels(lp), labs)
+
+
+@settings(max_examples=20, deadline=None)
+@given(magic=st.integers(0, 2**31 - 1))
+def test_idx_bad_magic_is_typed_error(tmp_path_factory, magic):
+    """Every non-2051 magic raises MnistError (≙ mnist.h's −2 code path),
+    never garbage data."""
+    if magic == 2051:
+        magic += 1
+    d = tmp_path_factory.mktemp("bad")
+    p = str(d / "bad.idx3")
+    open(p, "wb").write(struct.pack(">iiii", magic, 1, 28, 28) + b"\0" * 784)
+    with pytest.raises(mnist.MnistError):
+        mnist.load_idx_images(p)
+
+
+@settings(max_examples=15, deadline=None)
+@given(
+    b=st.integers(1, 6),
+    h=st.integers(4, 12),
+    w=st.integers(4, 12),
+    c=st.integers(1, 3),
+    pad=st.integers(0, 3),
+    seed=st.integers(0, 1000),
+)
+def test_augment_pixels_come_from_padded_input(b, h, w, c, pad, seed):
+    """Every augmented pixel value exists in {0} ∪ input values (crops
+    read only the zero-padded input; flips permute), and shape/dtype are
+    preserved — for arbitrary geometry, not just the CIFAR shape."""
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.uniform(0.5, 1.0, (b, h, w, c)).astype(np.float32))
+    out = random_crop_flip(jax.random.key(seed), x, pad=pad)
+    assert out.shape == x.shape and out.dtype == x.dtype
+    allowed = set(np.asarray(x).ravel().tolist()) | {0.0}
+    assert set(np.asarray(out).ravel().tolist()) <= allowed
+
+
+@settings(max_examples=50, deadline=None)
+@given(n=st.integers(1, 4096), want=st.integers(1, 512))
+def test_batch_block_is_a_divisor_within_bound(n, want):
+    bb = _batch_block(n, want)
+    assert 1 <= bb <= min(n, want)
+    assert n % bb == 0
+
+
+@settings(max_examples=50, deadline=None)
+@given(
+    n=st.sampled_from([32, 64, 128, 256, 512, 1024]),
+    rows=st.integers(16, 1300),
+    cin=st.sampled_from([3, 64, 128, 256, 512]),
+    cout=st.sampled_from([64, 128, 256, 512]),
+    taps=st.sampled_from([1, 9]),
+    esz=st.sampled_from([2, 4]),
+)
+def test_pick_bb_divides_batch_and_respects_budget(n, rows, cin, cout, taps, esz):
+    """The conv grid invariant: bb divides n; and the modeled scoped
+    footprint of the chosen block stays within the VMEM budget whenever
+    even a single image fits it (bb=1 is the documented floor)."""
+    bb = pc._pick_bb(n, rows, cin, cout, taps, esz, 4)
+    assert 1 <= bb <= n and n % bb == 0
+    per_img = rows * (esz * (2 * (cin + cout) + taps * cin) + 4 * 2 * cout)
+    w_bytes = 2 * taps * cin * cout * 4
+    if per_img + w_bytes <= pc._VMEM_BUDGET:
+        assert bb * per_img + w_bytes <= pc._VMEM_BUDGET
