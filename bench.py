@@ -28,8 +28,15 @@ Also reported (extra keys, same line):
   single-chip grading axis; the reference has no analog).
 - `pallas_max_abs_diff`: on-chip path-A-vs-B grad parity on one batch
   (compiled-Mosaic numerics evidence, docs/kernel_authoring.md rule 5).
-- `bf16_*` and `zoo_resnet18_*`: the bf16 mixed-precision row and the
-  MXU-saturation rows (ResNet-18 CIFAR, XLA and Pallas-conv backends).
+- `bf16_*`, `parity_epoch_s`, and `zoo_resnet18_*`: the bf16
+  mixed-precision row, the strict-parity 60k-sequential-update epoch
+  (vs Sequential's 102.317 s), and the MXU-saturation rows (ResNet-18
+  CIFAR, XLA and Pallas-conv backends).
+
+Optional rows run most-important-first under a wall-clock budget
+(PCNN_BENCH_TIME_BUDGET, default 480 s): an external kill prints no line
+at all, so rows that would blow the budget are labeled "skipped: time
+budget" instead of being attempted.
 """
 
 from __future__ import annotations
@@ -178,8 +185,28 @@ def _time_epochs(epoch_fn, params, images, labels) -> float:
     return max(elapsed - rtt, 1e-9)
 
 
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache (verified to work through the
+    relay: 1.9 s → 0.2 s on a cached conv kernel). A warm cache turns the
+    ~50 Mosaic/XLA compiles behind the optional rows from minutes into
+    seconds, which is what keeps the full line inside the time budget on
+    repeat runs."""
+    import jax
+
+    cache_dir = os.environ.get(
+        "PCNN_JAX_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass  # cache is an optimization, never a failure mode
+
+
 def main() -> None:
     platform = _resolve_platform()
+    _enable_compile_cache()
 
     import jax
     import jax.numpy as jnp
@@ -232,6 +259,18 @@ def main() -> None:
 
         return batch_grads
 
+    # Wall-clock budget for the optional rows: the driver runs this script
+    # with a finite patience, and an external kill prints NO line at all
+    # (the round-1 failure). Rows run most-important-first and each checks
+    # the remaining budget; a skipped row is labeled, never silent.
+    t_start = time.perf_counter()
+    time_budget = float(os.environ.get("PCNN_BENCH_TIME_BUDGET", "480"))
+
+    def time_left() -> float:
+        return time_budget - (time.perf_counter() - t_start)
+
+    SKIPPED = "skipped: time budget"
+
     n_images = STEPS_PER_EPOCH * BATCH * TIMED_REPEATS
     compute = _time_epochs(
         make_epoch(make_batch_grads("float32")), params, images, labels
@@ -244,77 +283,41 @@ def main() -> None:
     pallas_img_per_sec = None
     pallas_max_abs_diff = None
     if platform == "tpu" or os.environ.get("PCNN_BENCH_PALLAS"):
-        try:
-            pallas_compute = _time_epochs(
-                make_epoch(pk.batched_value_and_ref_grads), params, images, labels
-            )
-            pallas_img_per_sec = round(n_images / pallas_compute, 1)
-        except Exception as e:  # labeled, not fatal
-            pallas_img_per_sec = f"error: {type(e).__name__}: {e}"[:200]
-        # On-chip A-vs-B grad parity on one batch (kernel_authoring.md
-        # rule 5: interpret-mode tests can't catch Mosaic lowering gaps —
-        # this line is the compiled-numerics evidence). Own try block: a
-        # parity-check failure must not discard a measured throughput.
-        try:
-            ba = make_batch_grads("float32")
-            _, grads_a = jax.jit(ba)(params, images[0], labels[0])
-            _, grads_b = jax.jit(pk.batched_value_and_ref_grads)(
-                params, images[0], labels[0]
-            )
-            pallas_max_abs_diff = float(
-                jax.tree_util.tree_reduce(
-                    jnp.maximum,
-                    jax.tree_util.tree_map(
-                        lambda a, b: jnp.max(jnp.abs(a - b)), grads_a, grads_b
-                    ),
-                )
-            )
-            # A drift past tolerance is labeled by pallas_max_abs_diff
-            # itself (its own JSON field); the measured throughput stays.
-        except Exception as e:
-            pallas_max_abs_diff = f"error: {type(e).__name__}: {e}"[:200]
-
-    # bf16 throughput mode (train/step.py batched_step compute_dtype):
-    # f32 master weights, bf16 compute on the MXU — the documented
-    # trajectory-deviating mode, reported alongside the f32 headline.
-    bf16_img_per_sec = None
-    if platform == "tpu" or os.environ.get("PCNN_BENCH_BF16"):
-        try:
-            bf16_compute = _time_epochs(
-                make_epoch(make_batch_grads("bfloat16")), params, images, labels
-            )
-            bf16_img_per_sec = round(n_images / bf16_compute, 1)
-        except Exception as e:
-            bf16_img_per_sec = f"error: {type(e).__name__}: {e}"[:200]
-
-    # The MXU-saturation row (VERDICT r2 next #2): ResNet-18 (cifar_stem)
-    # bf16 training throughput + analytic-FLOPs MFU — LeNet's 379-kFLOP
-    # graph can't exercise the MXU; this is the number a TPU framework's
-    # ceiling is judged on. Batch 1024: measured 39%/49%/51% MFU at
-    # 512/1024/2048 — 1024 captures the knee without 2048's memory and
-    # compile cost.
-    zoo_img_per_sec = None
-    zoo_mfu = None
-    zoo_pallasconv_img_per_sec = None
-    if platform == "tpu" or os.environ.get("PCNN_BENCH_ZOO"):
-        try:
-            zoo_img_per_sec, zoo_mfu = _bench_resnet18(batch=ZOO_BATCH)
-        except Exception as e:  # labeled, not fatal
-            zoo_img_per_sec = f"error: {type(e).__name__}: {e}"[:200]
-        # Config #4's native-kernel cell: the same ResNet-18 with EVERY
-        # conv routed through the Pallas tapped-matmul kernels
-        # (ops/pallas_conv.py) instead of XLA's convs. Compiled Mosaic
-        # only — interpret mode at this scale is hours on CPU. Batch 512
-        # (not 1024): ~40 Mosaic kernel compiles dominate this row's cost
-        # and throughput is block-size-insensitive (ops/pallas_conv.py
-        # _VMEM_BUDGET note), so the smaller labeled batch bounds it.
-        if platform == "tpu":
+        if time_left() < 60:
+            pallas_img_per_sec = SKIPPED
+        else:
             try:
-                zoo_pallasconv_img_per_sec, _ = _bench_resnet18(
-                    conv_backend="pallas", batch=ZOO_PALLAS_BATCH
+                pallas_compute = _time_epochs(
+                    make_epoch(pk.batched_value_and_ref_grads),
+                    params, images, labels,
                 )
+                pallas_img_per_sec = round(n_images / pallas_compute, 1)
+            except Exception as e:  # labeled, not fatal
+                pallas_img_per_sec = f"error: {type(e).__name__}: {e}"[:200]
+            # On-chip A-vs-B grad parity on one batch (kernel_authoring.md
+            # rule 5: interpret-mode tests can't catch Mosaic lowering
+            # gaps — this line is the compiled-numerics evidence). Own try
+            # block: a parity-check failure must not discard a measured
+            # throughput.
+            try:
+                ba = make_batch_grads("float32")
+                _, grads_a = jax.jit(ba)(params, images[0], labels[0])
+                _, grads_b = jax.jit(pk.batched_value_and_ref_grads)(
+                    params, images[0], labels[0]
+                )
+                pallas_max_abs_diff = float(
+                    jax.tree_util.tree_reduce(
+                        jnp.maximum,
+                        jax.tree_util.tree_map(
+                            lambda a, b: jnp.max(jnp.abs(a - b)),
+                            grads_a, grads_b,
+                        ),
+                    )
+                )
+                # A drift past tolerance is labeled by pallas_max_abs_diff
+                # itself (its own JSON field); the throughput stays.
             except Exception as e:
-                zoo_pallasconv_img_per_sec = f"error: {type(e).__name__}: {e}"[:200]
+                pallas_max_abs_diff = f"error: {type(e).__name__}: {e}"[:200]
 
     xla_img_per_sec = img_per_sec
     img_per_sec, path = select_headline(
@@ -327,10 +330,65 @@ def main() -> None:
     # the driver line against Sequential's 102.317 s.
     parity_epoch_s = None
     if platform == "tpu" or os.environ.get("PCNN_BENCH_PARITY"):
-        try:
-            parity_epoch_s = _bench_parity_epoch()
-        except Exception as e:  # labeled, not fatal
-            parity_epoch_s = f"error: {type(e).__name__}: {e}"[:200]
+        if time_left() < 60:
+            parity_epoch_s = SKIPPED
+        else:
+            try:
+                parity_epoch_s = _bench_parity_epoch()
+            except Exception as e:  # labeled, not fatal
+                parity_epoch_s = f"error: {type(e).__name__}: {e}"[:200]
+
+    # The MXU-saturation row (VERDICT r2 next #2): ResNet-18 (cifar_stem)
+    # bf16 training throughput + analytic-FLOPs MFU — LeNet's 379-kFLOP
+    # graph can't exercise the MXU; this is the number a TPU framework's
+    # ceiling is judged on. Batch 1024: measured 39%/49%/51% MFU at
+    # 512/1024/2048 — 1024 captures the knee without 2048's memory and
+    # compile cost.
+    zoo_img_per_sec = None
+    zoo_mfu = None
+    zoo_pallasconv_img_per_sec = None
+    if platform == "tpu" or os.environ.get("PCNN_BENCH_ZOO"):
+        if time_left() < 90:
+            zoo_img_per_sec = SKIPPED
+        else:
+            try:
+                zoo_img_per_sec, zoo_mfu = _bench_resnet18(batch=ZOO_BATCH)
+            except Exception as e:  # labeled, not fatal
+                zoo_img_per_sec = f"error: {type(e).__name__}: {e}"[:200]
+
+    # bf16 throughput mode (train/step.py batched_step compute_dtype):
+    # f32 master weights, bf16 compute on the MXU — the documented
+    # trajectory-deviating mode, reported alongside the f32 headline.
+    bf16_img_per_sec = None
+    if platform == "tpu" or os.environ.get("PCNN_BENCH_BF16"):
+        if time_left() < 45:
+            bf16_img_per_sec = SKIPPED
+        else:
+            try:
+                bf16_compute = _time_epochs(
+                    make_epoch(make_batch_grads("bfloat16")),
+                    params, images, labels,
+                )
+                bf16_img_per_sec = round(n_images / bf16_compute, 1)
+            except Exception as e:
+                bf16_img_per_sec = f"error: {type(e).__name__}: {e}"[:200]
+
+    # Config #4's native-kernel cell, LAST (most expensive, ~40 Mosaic
+    # kernel compiles): the same ResNet-18 with EVERY conv routed through
+    # the Pallas tapped-matmul kernels (ops/pallas_conv.py). Compiled
+    # Mosaic only — interpret mode at this scale is hours on CPU. Batch
+    # 512 (not 1024): compile cost dominates this row and throughput is
+    # block-size-insensitive (ops/pallas_conv.py _VMEM_BUDGET note).
+    if platform == "tpu":
+        if time_left() < 330:
+            zoo_pallasconv_img_per_sec = SKIPPED
+        else:
+            try:
+                zoo_pallasconv_img_per_sec, _ = _bench_resnet18(
+                    conv_backend="pallas", batch=ZOO_PALLAS_BATCH
+                )
+            except Exception as e:
+                zoo_pallasconv_img_per_sec = f"error: {type(e).__name__}: {e}"[:200]
 
     # MFU on TPU by default (v5e peaks, dtype-matched), or on any platform
     # when the user supplies their chip's peak via PCNN_PEAK_FLOPS*.

@@ -36,6 +36,13 @@ if os.environ.get("PCNN_JAX_PLATFORMS"):
 import jax.numpy as jnp
 import numpy as np
 
+# Persistent XLA compilation cache (works through the relay): one shared
+# implementation with the driver headline script — repeat suite runs skip
+# recompiles.
+import bench as _bench
+
+_bench._enable_compile_cache()
+
 # Reference numbers (BASELINE.md; paper PDF §6 Tables 1-8).
 SEQ_EPOCH_S = 102.317095          # Table 1 (60k samples, CPU VM)
 CUDA_EPOCH_S = 2.9969857          # Table 8 (T4)
