@@ -512,6 +512,13 @@ def main(argv=None) -> int:
     )
     args = ap.parse_args(argv)
 
+    # Never hang on a dead TPU tunnel (bench.py's round-1 lesson, applied
+    # to the suite harness too): probe default-backend health in a
+    # subprocess and fall back to a labeled CPU run. No-op when
+    # PCNN_JAX_PLATFORMS already pinned the platform.
+    platform = _bench._resolve_platform()
+    print(f"[platform] {platform}", flush=True)
+
     suites = {
         "lenet": bench_lenet_throughput,
         "parity": bench_lenet_parity_epoch,
@@ -536,7 +543,7 @@ def main(argv=None) -> int:
         with open(args.md, "w") as f:
             f.write(
                 f"# Benchmark results\n\nplatform: "
-                f"{jax.devices()[0].platform} ×{len(jax.devices())}\n\n"
+                f"{platform} ×{len(jax.devices())}\n\n"
                 + render_md(rows)
                 + "\n"
             )
