@@ -73,9 +73,20 @@ def test_sync_time_raises_when_rtt_dominates(benchrun, monkeypatch):
 
 
 def test_sync_time_measures_a_real_thunk(benchrun):
+    # The thunk must do real work: _sync_time (correctly) REFUSES to
+    # report a timed region smaller than the readback RTT, so a trivial
+    # v+1 thunk would be a flake on a loaded machine.
+    import jax
+
+    m = jnp.ones((400, 400))
+
+    @jax.jit
+    def step(v):
+        return (v @ m).mean() * 1e-3
+
     def thunk(carry):
-        v = jnp.float32(0.0) if carry is None else carry
-        return v + 1.0
+        v = jnp.ones((400, 400)) if carry is None else jnp.full((400, 400), carry)
+        return step(v)
 
     sec = benchrun._sync_time(thunk, repeats=3)
     assert sec > 0 and math.isfinite(sec)
