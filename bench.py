@@ -17,7 +17,8 @@ Method: the minibatch reference-contract epoch (train/step.py:batched_step
 semantics) compiled as ONE jitted lax.scan over the whole epoch — no host
 round-trips, timed with block_until_ready (contrast: the reference's CUDA
 timings never sync, SURVEY.md B11) — measured on BOTH op paths on TPU (or
-with PCNN_BENCH_PALLAS set; the CPU fallback times path A only). `value`
+with PCNN_BENCH_PALLAS set; the CPU fallback times path A plus the
+strict-parity epoch row — see below). `value`
 is the fastest full-contract path: the XLA ops (path A), or the fused
 Pallas megakernel (path B) when it wins and its on-chip grad diff vs
 path A is within PALLAS_PARITY_TOL; `path` labels which won, `xla_img_per_sec` /
