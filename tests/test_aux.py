@@ -130,10 +130,14 @@ def test_cli_end_to_end_with_checkpoint_resume(tmp_path):
 
     # resume: asks for 3 epochs total, 2 already done → exactly 1 more
     r2 = _run_cli(base[:-4] + ["--epochs", "3", "--resume",
-                               "--checkpoint-dir", ckpt])
+                               "--checkpoint-dir", ckpt, "--profile"])
     assert r2.returncode == 0, r2.stderr
     assert "resumed from" in r2.stdout
     assert r2.stdout.count("error:") == 1
+    # --profile prints the per-phase table (paper Tables 4-8 shape) after
+    # training — the one driver flag no CLI test exercised.
+    for phase in ("conv", "pool", "fc"):
+        assert phase in r2.stdout, r2.stdout[-500:]
 
 
 @pytest.mark.slow
