@@ -15,8 +15,10 @@ the run falls back to CPU and the line is labeled `"platform": "cpu"`.
 
 Method: the minibatch reference-contract epoch (train/step.py:batched_step
 semantics) compiled as ONE jitted lax.scan over the whole epoch — no host
-round-trips, timed with block_until_ready (contrast: the reference's CUDA
-timings never sync, SURVEY.md B11) — measured on BOTH op paths on TPU (or
+round-trips, timed with a host readback barrier + RTT subtraction
+(block_until_ready is insufficient through the relay — it can return while
+remote execution is in flight; contrast also the reference's CUDA timings,
+which never sync at all, SURVEY.md B11) — measured on BOTH op paths on TPU (or
 with PCNN_BENCH_PALLAS set; the CPU fallback times path A plus the
 strict-parity epoch row — see below). `value`
 is the fastest full-contract path: the XLA ops (path A), or the fused
@@ -112,7 +114,7 @@ def select_headline(xla_ips, pallas_ips, pallas_diff):
     return xla_ips, "xla"
 
 
-def _resolve_platform() -> str:
+def _resolve_platform(wait_deadline: float | None = None) -> str:
     """Initialize a usable jax backend without ever hanging.
 
     The ambient `axon` plugin tunnels to a remote TPU; when the tunnel is
@@ -132,16 +134,50 @@ def _resolve_platform() -> str:
         return canonical_platform()
 
     timeout = float(os.environ.get("PCNN_BACKEND_PROBE_TIMEOUT", "120"))
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True,
-            text=True,
-            timeout=timeout,
+    # A CPU-fallback line scores as a missing TPU artifact (round-3
+    # lesson: the relay died mid-round and BENCH_r03 landed on CPU), so
+    # before conceding, keep re-probing with backoff for a wait window —
+    # transient relay outages often heal within minutes. Every probe runs
+    # in a subprocess with a hard timeout, so the never-hang contract
+    # holds throughout; PCNN_BENCH_TPU_WAIT=0 restores single-probe
+    # behavior. A probe that SUCCEEDS but reports a cpu-only backend
+    # (axon plugin loaded, no TPU exposed) counts as not-TPU and keeps
+    # waiting — that mode would otherwise reproduce BENCH_r03 exactly.
+    # The wait window is additionally capped by `wait_deadline` (main's
+    # overall time budget): a driver with finite patience killing the
+    # process mid-wait would print NO line at all.
+    wait_budget = float(os.environ.get("PCNN_BENCH_TPU_WAIT", "600"))
+    t_probe0 = time.perf_counter()
+    if wait_deadline is not None:
+        wait_budget = min(wait_budget, wait_deadline - t_probe0)
+    attempt = 0
+    healthy = False
+    while True:
+        attempt += 1
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                capture_output=True,
+                text=True,
+                timeout=timeout,
+            )
+            probed = proc.stdout.strip() if proc.returncode == 0 else ""
+            healthy = bool(probed) and probed != "cpu"
+        except (subprocess.TimeoutExpired, OSError):
+            healthy = False
+        if healthy:
+            break
+        remaining = wait_budget - (time.perf_counter() - t_probe0)
+        if remaining <= 0:
+            break
+        backoff = min(15.0 * attempt, 60.0, remaining)
+        print(
+            f"[bench] backend probe {attempt} found no TPU; retrying in "
+            f"{backoff:.0f}s ({remaining:.0f}s of TPU wait budget left)",
+            file=sys.stderr, flush=True,
         )
-        healthy = proc.returncode == 0 and bool(proc.stdout.strip())
-    except (subprocess.TimeoutExpired, OSError):
-        healthy = False
+        time.sleep(backoff)
 
     if not healthy:
         jax.config.update("jax_platforms", "cpu")
@@ -154,6 +190,35 @@ def _readback(x) -> float:
     """True execution barrier: block_until_ready can return before remote
     (tunneled) execution finishes; only a host readback drains the queue."""
     return float(x)
+
+
+_drain_cache: dict = {}
+
+
+def _drain_all(tree) -> None:
+    """Full-pytree barrier in ONE host readback: jit a scalar that consumes
+    every leaf and read that back. Per-leaf np.asarray would pay one ~100 ms
+    relay RTT per leaf (ZooState has 100+ leaves — tens of seconds of pure
+    readback inside a timed region); a single-leaf readback is the opposite
+    hazard (it only drains that leaf's dependency cone). Same design as
+    benches/run.py:_drain."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    leaves = [l for l in jax.tree_util.tree_leaves(tree) if hasattr(l, "dtype")]
+    key = tuple((l.shape, str(l.dtype)) for l in leaves)
+    fn = _drain_cache.get(key)
+    if fn is None:
+        def _reduce(*ls):
+            tot = jnp.float32(0.0)
+            for l in ls:
+                tot = tot + jnp.sum(jnp.abs(l.astype(jnp.float32)))
+            return tot
+
+        fn = jax.jit(_reduce)
+        _drain_cache[key] = fn
+    np.asarray(fn(*leaves))
 
 
 def _time_epochs(epoch_fn, params, images, labels) -> float:
@@ -206,7 +271,16 @@ def _enable_compile_cache() -> None:
 
 
 def main() -> None:
+    t_proc0 = time.perf_counter()
+    time_budget = float(os.environ.get("PCNN_BENCH_TIME_BUDGET", "480"))
     platform = _resolve_platform()
+    if platform != "tpu":
+        # The TPU wait (up to PCNN_BENCH_TPU_WAIT) failed: charge it
+        # against the row budget — after a long fruitless wait the right
+        # output is a FAST labeled CPU line, not wait + full budget
+        # stacked (a driver with finite patience killing the process
+        # prints no line at all). Floor keeps the mandatory rows viable.
+        time_budget = max(180.0, time_budget - (time.perf_counter() - t_proc0))
     _enable_compile_cache()
 
     import jax
@@ -264,8 +338,8 @@ def main() -> None:
     # with a finite patience, and an external kill prints NO line at all
     # (the round-1 failure). Rows run most-important-first and each checks
     # the remaining budget; a skipped row is labeled, never silent.
+    # (time_budget set at the top of main — a failed TPU wait is deducted.)
     t_start = time.perf_counter()
-    time_budget = float(os.environ.get("PCNN_BENCH_TIME_BUDGET", "480"))
 
     def time_left() -> float:
         return time_budget - (time.perf_counter() - t_start)
@@ -273,26 +347,50 @@ def main() -> None:
     SKIPPED = "skipped: time budget"
 
     n_images = STEPS_PER_EPOCH * BATCH * TIMED_REPEATS
-    compute = _time_epochs(
-        make_epoch(make_batch_grads("float32")), params, images, labels
-    )
-    img_per_sec = n_images / compute
+
+    # Relay-variance protocol (VERDICT r3 next #7): XLA-path throughput
+    # varies ±20% run-to-run through the relay, so the headline is the
+    # MEDIAN of N same-session samples, with the min–max range reported
+    # alongside. Each sample is a full _time_epochs measurement (warmed,
+    # chained, RTT-corrected). N=1 on the CPU fallback (no relay there,
+    # and the fallback should stay cheap).
+    def median(xs):
+        s = sorted(xs)
+        n = len(s)
+        return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+    n_samples = int(os.environ.get(
+        "PCNN_BENCH_SAMPLES", "3" if platform == "tpu" else "1"
+    ))
+
+    def sample_ips(epoch_fn, n):
+        out = []
+        for _ in range(max(n, 1)):
+            out.append(round(n_images / _time_epochs(
+                epoch_fn, params, images, labels
+            ), 1))
+            if time_left() < 120:
+                break  # keep remaining budget for the other rows
+        return out
+
+    xla_samples = sample_ips(make_epoch(make_batch_grads("float32")), n_samples)
+    img_per_sec = median(xla_samples)
 
     # Path B: the same epoch on the FUSED Pallas megakernel — compiled
     # Mosaic when platform == "tpu" (ops/pallas.py:_interpret). Never allowed
     # to take down the headline number.
     pallas_img_per_sec = None
+    pallas_samples = None
     pallas_max_abs_diff = None
     if platform == "tpu" or os.environ.get("PCNN_BENCH_PALLAS"):
         if time_left() < 60:
             pallas_img_per_sec = SKIPPED
         else:
             try:
-                pallas_compute = _time_epochs(
-                    make_epoch(pk.batched_value_and_ref_grads),
-                    params, images, labels,
+                pallas_samples = sample_ips(
+                    make_epoch(pk.batched_value_and_ref_grads), n_samples
                 )
-                pallas_img_per_sec = round(n_images / pallas_compute, 1)
+                pallas_img_per_sec = round(median(pallas_samples), 1)
             except Exception as e:  # labeled, not fatal
                 pallas_img_per_sec = f"error: {type(e).__name__}: {e}"[:200]
             # On-chip A-vs-B grad parity on one batch (kernel_authoring.md
@@ -324,6 +422,7 @@ def main() -> None:
     img_per_sec, path = select_headline(
         img_per_sec, pallas_img_per_sec, pallas_max_abs_diff
     )
+    headline_samples = pallas_samples if path == "pallas_fused" else xla_samples
 
     # The strict-parity epoch (≙ the reference's Table-1 workload: 60k
     # SEQUENTIAL per-sample SGD updates as one lax.scan) — the most
@@ -412,10 +511,18 @@ def main() -> None:
                 "vs_baseline": round(img_per_sec / CUDA_BASELINE_IMG_PER_SEC, 2),
                 "platform": platform,
                 "path": path,
+                "value_median": round(img_per_sec, 1),
+                "value_range": (
+                    [min(headline_samples), max(headline_samples)]
+                    if headline_samples else None
+                ),
+                "value_samples": len(headline_samples) if headline_samples else 0,
                 "mfu": mfu,
                 "flops_per_image": FLOPS_PER_IMAGE,
                 "xla_img_per_sec": round(xla_img_per_sec, 1),
+                "xla_samples": xla_samples,
                 "pallas_img_per_sec": pallas_img_per_sec,
+                "pallas_samples": pallas_samples,
                 "pallas_max_abs_diff": pallas_max_abs_diff,
                 "bf16_img_per_sec": bf16_img_per_sec,
                 "parity_epoch_s": parity_epoch_s,
@@ -450,17 +557,13 @@ def _bench_parity_epoch() -> float:
     labels = jnp.asarray(rng.integers(0, 10, (n,)).astype(np.int32))
     p = lenet_ref.init(jax.random.key(0))
 
-    def drain(tree):
-        for leaf in jax.tree_util.tree_leaves(tree):
-            np.asarray(leaf)
-
     p, err = step_lib.scan_epoch(p, images, labels, 0.1)
-    drain((p, err))
+    _drain_all((p, err))
     t0 = time.perf_counter()
     reps = 2
     for _ in range(reps):
         p, err = step_lib.scan_epoch(p, images, labels, 0.1)
-    drain((p, err))
+    _drain_all((p, err))
     return round((time.perf_counter() - t0) / reps, 4)
 
 
@@ -491,12 +594,17 @@ def _bench_resnet18(conv_backend: str = "xla", batch: int = 1024):
     st = zoo.init_state(model, jax.random.key(0), cifar.IN_SHAPE, opt)
     step = zoo.make_train_step(model, opt)
 
+    # Full-pytree barrier (ONE readback): the final step's loss depends
+    # only on that step's forward, so a single-leaf readback would stop
+    # the clock before the last backward + optimizer update (~2/3 of one
+    # step) finishes — the partial-barrier hazard benches/run.py._drain
+    # documents.
     st, loss = step(st, x, y)
-    _readback(loss)
+    _drain_all(st)
     t0 = time.perf_counter()
     for _ in range(steps):
         st, loss = step(st, x, y)
-    _readback(loss)
+    _drain_all(st)
     sec = time.perf_counter() - t0
     ips = steps * batch / sec
     return round(ips, 1), round(

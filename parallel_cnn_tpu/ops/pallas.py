@@ -82,6 +82,13 @@ def _interpret() -> bool:
     return not is_tpu()
 
 
+# Test hook: force the fused path's x25 operand to stay f32 even when
+# compiled (the bf16 store is a measured-zero-cost optimization that rests
+# on an XLA lowering detail — see fused_value_and_ref_grads). Monkeypatched
+# by test_fused_bf16_store_vs_f32_store to diff the two stores on-chip.
+_FORCE_X25_F32 = False
+
+
 def _batch_block(n: int, want: int = 128) -> int:
     """Largest divisor of n that is ≤ want (grid must tile the batch)."""
     b = min(n, want)
@@ -758,7 +765,7 @@ def fused_value_and_ref_grads(
         .reshape(n_pad, 25, 576)
         .transpose(1, 0, 2)
     )
-    if not _interpret():
+    if not _interpret() and not _FORCE_X25_F32:
         # STORE the dominant operand in bf16 (compute stays f32 — the
         # kernel's FMAs/dots promote on read). Zero numerics cost on the
         # chip: the patches conv above runs Precision.DEFAULT, whose MXU
@@ -769,6 +776,11 @@ def fused_value_and_ref_grads(
         # second session — relay variance, docs/bench_results.md).
         # Interpret mode (CPU tests) keeps exact f32: there
         # the patches op is exact, so a bf16 store WOULD change numerics.
+        # DEPENDENCY: "zero cost" rests on an XLA lowering detail — if
+        # patch extraction is ever lowered as pure data movement (no MXU
+        # pass), this cast becomes a real precision loss. Guarded by the
+        # TPU-gated regression test
+        # tests/test_ops_pallas.py::test_fused_bf16_store_vs_f32_store.
         x25 = x25.astype(jnp.bfloat16)
     # One-hot labels padded to 16 lanes; lane 10 doubles as the pad-sample
     # mask (1 for real rows, 0 for pad rows — zeroing d_pre_f and with it

@@ -148,3 +148,31 @@ def test_uneven_batch_pads_and_masks():
     )
     acts = pk.forward(params, xs)
     assert acts.out_f.shape == (6, 10)
+
+
+def test_fused_bf16_store_vs_f32_store(monkeypatch):
+    """Compiled-mode guard for the fused path's bf16 x25 store (ADVICE r3).
+
+    The "zero numerics cost" claim rests on an XLA lowering detail:
+    conv_general_dilated_patches' MXU passes already quantize to bf16
+    under Precision.DEFAULT, so storing x25 in bf16 changes nothing. If a
+    future XLA lowers patch extraction as pure data movement, the cast
+    silently becomes a real precision loss — this test diffs the grads of
+    the bf16-store vs forced-f32-store fused step ON-CHIP and fails if
+    they drift past f32-reassociation noise. TPU-only: in interpret mode
+    the bf16 store is disabled by construction (both runs identical).
+    """
+    from parallel_cnn_tpu.utils.backend import is_tpu
+
+    if not is_tpu():
+        pytest.skip("compiled-Mosaic lowering guard; interpret mode "
+                    "disables the bf16 store by construction")
+    params = lenet_ref.init(jax.random.key(5))
+    rng = np.random.default_rng(11)
+    xs = jnp.asarray(rng.uniform(0, 1, (128, 28, 28)).astype(np.float32))
+    ys = jnp.asarray(rng.integers(0, 10, (128,)).astype(np.int32))
+    err_bf16, grads_bf16 = pk.fused_value_and_ref_grads(params, xs, ys)
+    monkeypatch.setattr(pk, "_FORCE_X25_F32", True)
+    err_f32, grads_f32 = pk.fused_value_and_ref_grads(params, xs, ys)
+    np.testing.assert_allclose(float(err_bf16), float(err_f32), atol=1e-5)
+    tree_allclose(grads_bf16, grads_f32, atol=1e-4)
