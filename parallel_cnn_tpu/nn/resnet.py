@@ -172,11 +172,14 @@ def _resnet(
             ReLU(),
         ]
     else:
-        # 7×7 stem stays XLA — outside the pallas kernel library's shape
-        # coverage (ops/pallas_conv.py:supports); every other conv in the
-        # network is 3×3 or 1×1.
+        # Round 4: the 7×7-stride-2 stem joined the pallas kernel
+        # library's coverage (ops/pallas_conv.py generalized tap
+        # geometry), so conv_backend="pallas" now puts EVERY conv in
+        # ResNet-50 on hand-written kernels. MaxPool stays XLA (pooling,
+        # not conv).
         stem = [
-            Conv2D(64, kernel=(7, 7), strides=(2, 2), use_bias=False),
+            Conv2D(64, kernel=(7, 7), strides=(2, 2), use_bias=False,
+                   backend=conv_backend),
             BatchNorm(),
             ReLU(),
             MaxPool(window=(3, 3), strides=(2, 2), padding="SAME"),
