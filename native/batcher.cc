@@ -25,8 +25,6 @@
 
 namespace {
 
-constexpr long kImageSize = 28 * 28;
-
 // xorshift64* — tiny, seedable, good enough for epoch permutations.
 struct XorShift64 {
   uint64_t s;
@@ -49,6 +47,7 @@ struct Batcher {
   const int32_t* labels; // borrowed
   long n;
   long batch;
+  long sample_size;  // floats per sample (28·28 MNIST, 32·32·3 CIFAR, …)
   bool shuffle;
   XorShift64 rng;
 
@@ -77,8 +76,8 @@ struct Batcher {
         if (shuffle) reshuffle();
       }
       const long src = perm[cursor++];
-      std::memcpy(slot->x.data() + b * kImageSize, images + src * kImageSize,
-                  sizeof(float) * kImageSize);
+      std::memcpy(slot->x.data() + b * sample_size,
+                  images + src * sample_size, sizeof(float) * sample_size);
       slot->y[size_t(b)] = labels[src];
     }
   }
@@ -104,24 +103,27 @@ struct Batcher {
 
 extern "C" {
 
-// images: (n, 28, 28) float32, labels: (n,) int32 — borrowed for the
+// images: (n, sample_size) float32 (any per-sample shape, flattened —
+// 28·28 MNIST, 32·32·3 CIFAR, …), labels: (n,) int32 — borrowed for the
 // batcher's lifetime. depth = ring slots (≥2 for overlap).
 void* pcnn_batcher_create(const float* images, const int32_t* labels, long n,
-                          long batch, long depth, uint64_t seed,
-                          int shuffle) {
+                          long sample_size, long batch, long depth,
+                          uint64_t seed, int shuffle) {
   // batch > n would wrap the cursor mid-batch and silently duplicate
   // samples within one batch (reshuffling mid-batch under shuffle).
-  if (n <= 0 || batch <= 0 || batch > n || depth < 1) return nullptr;
+  if (n <= 0 || sample_size <= 0 || batch <= 0 || batch > n || depth < 1)
+    return nullptr;
   auto* b = new Batcher();
   b->images = images;
   b->labels = labels;
   b->n = n;
   b->batch = batch;
+  b->sample_size = sample_size;
   b->shuffle = shuffle != 0;
   b->rng.s = seed ? seed : 0x9E3779B97F4A7C15ULL;
   b->ring.resize(size_t(depth));
   for (auto& slot : b->ring) {
-    slot.x.resize(size_t(batch) * kImageSize);
+    slot.x.resize(size_t(batch) * size_t(sample_size));
     slot.y.resize(size_t(batch));
   }
   b->perm.resize(size_t(n));

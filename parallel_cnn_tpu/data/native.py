@@ -78,9 +78,10 @@ def _load_lib() -> ctypes.CDLL:
     lib.pcnn_batcher_create.argtypes = [
         ctypes.POINTER(ctypes.c_float),
         ctypes.POINTER(ctypes.c_int32),
-        ctypes.c_long,
-        ctypes.c_long,
-        ctypes.c_long,
+        ctypes.c_long,  # n
+        ctypes.c_long,  # sample_size
+        ctypes.c_long,  # batch
+        ctypes.c_long,  # depth
         ctypes.c_uint64,
         ctypes.c_int,
     ]
@@ -160,6 +161,10 @@ class Batcher:
     wrap, reshuffling when shuffle=True); bound iteration with
     `itertools.islice` or `steps_per_epoch`.
 
+    Shape-generic: images may be (N, 28, 28) MNIST, (N, 32, 32, 3) CIFAR,
+    or any (N, ...) float32 array — the ring copies flat samples and the
+    views are reshaped back to the per-sample shape.
+
     copy=True (default) hands out freshly-owned arrays, safe to pass to
     asynchronous consumers (jax.device_put's H2D may still be in flight
     when the next batch is requested). copy=False hands out zero-copy views
@@ -190,10 +195,15 @@ class Batcher:
                 f"{self._images.shape[0]}"
             )
         self.batch_size = batch_size
+        self._sample_shape = self._images.shape[1:]
+        sample_size = 1
+        for d in self._sample_shape:
+            sample_size *= d
         self._handle = _lib.pcnn_batcher_create(
             self._images.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             self._labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             self._images.shape[0],
+            sample_size,
             batch_size,
             depth,
             seed,
@@ -222,7 +232,7 @@ class Batcher:
         )
         if rc != 0:
             raise StopIteration
-        x = np.ctypeslib.as_array(xp, shape=(self.batch_size, 28, 28))
+        x = np.ctypeslib.as_array(xp, shape=(self.batch_size,) + self._sample_shape)
         y = np.ctypeslib.as_array(yp, shape=(self.batch_size,))
         if self._copy:
             x, y = x.copy(), y.copy()

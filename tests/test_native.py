@@ -116,6 +116,7 @@ def test_batcher_rejects_batch_larger_than_dataset(idx_files):
             imgs.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             imgs.shape[0],
+            28 * 28,
             imgs.shape[0] + 1,
             2,
             1,
@@ -194,3 +195,28 @@ def test_native_parses_reference_real_label_files(path, count):
     got = native.load_idx_labels(path)
     assert got.shape == (count,) and got.dtype == np.int32
     np.testing.assert_array_equal(got, mnist.load_idx_labels(path))
+
+def test_batcher_shape_generic_cifar():
+    """The ring is shape-generic (VERDICT r3 next #5): a (N, 32, 32, 3)
+    CIFAR-shaped dataset flows through the SAME native pipeline, and its
+    batches bit-match the NumPy twin — mirroring
+    test_native_semantics_batches_matches_batcher at the zoo's shape."""
+    from parallel_cnn_tpu.data import pipeline
+
+    rng = np.random.default_rng(7)
+    imgs = rng.uniform(0, 1, (64, 32, 32, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, (64,)).astype(np.int32)
+    ds = pipeline.Dataset(imgs, labels)
+    bs = 7  # ragged: exercises drop-tail on both sides
+    steps = len(ds) // bs
+    fallback = list(
+        pipeline.native_semantics_batches(ds, bs, shuffle=True, seed=21)
+    )
+    assert len(fallback) == steps
+    with native.Batcher(imgs, labels, bs, seed=21, shuffle=True) as it:
+        for (fx, fy), (nx, ny) in zip(
+            fallback, itertools.islice(it, steps), strict=True
+        ):
+            assert nx.shape == (bs, 32, 32, 3)
+            np.testing.assert_array_equal(fx, nx)
+            np.testing.assert_array_equal(fy, ny)

@@ -395,3 +395,29 @@ def test_zoo_augment_composes_with_dp_mesh():
     )
     assert len(losses) == 2 and all(np.isfinite(l) for l in losses)
     assert losses[-1] < losses[0], losses
+
+
+def test_zoo_native_loader_trains():
+    """loader="native" feeds the zoo trainer from the C++ prefetch ring
+    (or its bit-identical NumPy twin without a toolchain) — the data
+    runtime serving the shapes the rest of the framework reached
+    (VERDICT r3 next #5). Determinism: two runs with the same seed give
+    the same loss trajectory."""
+    from parallel_cnn_tpu.data import synthetic
+    from parallel_cnn_tpu.nn import cifar
+
+    imgs, labels = synthetic.make_image_dataset(96, seed=4)
+    model = cifar.cifar_cnn()
+
+    def run():
+        _, losses = zoo.train(
+            model, imgs, labels, in_shape=cifar.IN_SHAPE,
+            epochs=2, batch_size=32, lr=0.05, seed=11,
+            loader="native", verbose=False,
+        )
+        return losses
+
+    l1, l2 = run(), run()
+    assert len(l1) == 2 and all(np.isfinite(l) for l in l1)
+    assert l1 == l2
+    assert l1[1] < l1[0]  # it actually learns
