@@ -1,0 +1,158 @@
+"""Mosaic capability + layout probes for the megakernel roof attack
+(VERDICT r3 next #4 / docs/future_work.md §4). TPU-only: each probe
+compiles a tiny Pallas kernel and reports LOWERED / REJECTED plus a
+rough timing, so the round's on-chip time is spent measuring, not
+authoring.
+
+    python benches/mosaic_probe.py
+
+Probes:
+1. rank3-dot     — dot_general with a batch dim inside a TPU kernel
+                   (the round-3 blocker for MXU-ing the conv taps).
+2. lane-merge    — in-kernel reshape (25, Bb, 576) → (25, Bb*576)
+                   (the other blocker: would let one (6,25)@(25,L) MXU
+                   dot replace 150 VPU tap-FMA rows).
+3. mxu-conv-L    — the (25, L=Bb*576) HOST-layout variant: one
+                   (6,25)@(25,L) dot per block vs the 150-FMA loop,
+                   timed head-to-head (feasibility of splitting the
+                   fused kernel's conv onto the MXU with NO in-kernel
+                   relayout — the (6,L) result then needs a
+                   lane-split reshape to (Bb,576) per filter, probe 4).
+4. lane-split    — in-kernel reshape (1, L) → (Bb, 576).
+
+Each probe is wrapped: a Mosaic lowering rejection prints the error
+class, never a crash. Exit code 0 always (informational tool).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BB = 128
+L = BB * 576
+
+
+def _run(name, fn):
+    try:
+        t0 = time.perf_counter()
+        out = fn()
+        jax.block_until_ready(out)
+        first = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(10):
+            out = fn()
+        jax.block_until_ready(out)
+        steady = (time.perf_counter() - t0) / 10
+        print(f"[{name}] LOWERED  first={first * 1e3:.1f}ms "
+              f"steady={steady * 1e6:.0f}us")
+        return True
+    except Exception as e:  # noqa: BLE001 — report, don't crash
+        msg = f"{type(e).__name__}: {e}"
+        print(f"[{name}] REJECTED {msg[:300]}")
+        return False
+
+
+def probe_rank3_dot():
+    def kernel(a_ref, b_ref, o_ref):
+        # (4, 64, 128) @ (4, 128, 64) batched over dim 0
+        o_ref[:] = lax.dot_general(
+            a_ref[:], b_ref[:],
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+
+    a = jnp.ones((4, 64, 128), jnp.float32)
+    b = jnp.ones((4, 128, 64), jnp.float32)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((4, 64, 64), jnp.float32),
+    )(a, b)
+
+
+def probe_lane_merge():
+    def kernel(x_ref, o_ref):
+        o_ref[:] = x_ref[:].reshape(25, BB * 576)
+
+    x = jnp.ones((25, BB, 576), jnp.float32)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((25, BB * 576), jnp.float32),
+    )(x)
+
+
+def probe_lane_split():
+    def kernel(x_ref, o_ref):
+        o_ref[:] = x_ref[:].reshape(BB, 576)
+
+    x = jnp.ones((1, L), jnp.float32)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((BB, 576), jnp.float32),
+    )(x)
+
+
+def _mxu_conv_L_kernel(w_ref, x_ref, o_ref):
+    o_ref[:] = lax.dot_general(
+        w_ref[:], x_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _vpu_conv_kernel(w_ref, x_ref, o_ref):
+    # the megakernel's current form: 6 filters × 25 tap-FMAs on the VPU
+    for m in range(6):
+        acc = jnp.zeros((BB, 576), jnp.float32)
+        for t in range(25):
+            acc += w_ref[m, t] * x_ref[t]
+        o_ref[m] = acc
+
+
+def probe_mxu_conv_L():
+    w = jnp.ones((6, 25), jnp.float32)
+    x = jnp.ones((25, L), jnp.bfloat16)
+    return pl.pallas_call(
+        _mxu_conv_L_kernel,
+        out_shape=jax.ShapeDtypeStruct((6, L), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024
+        ),
+    )(w, x)
+
+
+def probe_vpu_conv_baseline():
+    w = jnp.ones((6, 25), jnp.float32)
+    x = jnp.ones((25, BB, 576), jnp.bfloat16)
+    return pl.pallas_call(
+        _vpu_conv_kernel,
+        out_shape=jax.ShapeDtypeStruct((6, BB, 576), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024
+        ),
+    )(w, x)
+
+
+def main():
+    from parallel_cnn_tpu.utils.backend import is_tpu
+
+    if not is_tpu():
+        print("mosaic_probe: needs a TPU (compiled Mosaic); current "
+              "backend is not TPU — nothing probed")
+        return 0
+    _run("rank3-dot", probe_rank3_dot)
+    _run("lane-merge", probe_lane_merge)
+    _run("lane-split", probe_lane_split)
+    _run("vpu-conv-baseline", probe_vpu_conv_baseline)
+    _run("mxu-conv-L", probe_mxu_conv_L)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -147,9 +147,12 @@ def _sync_time(thunk, repeats: int) -> float:
         if corrected > 0 and elapsed >= 4 * rtt:
             return corrected / repeats
         ran = repeats  # what this attempt actually executed (for the error)
-        # Scale repeats so the next attempt lands ~8× over the RTT floor.
+        # Scale repeats so the next attempt lands ~8× over the RTT floor —
+        # capped: an absurd RTT (relay glitch, or a test stubbing it) must
+        # exhaust the 4 attempts and raise, not spin for 8·rtt/per_rep
+        # iterations.
         per_rep = max(elapsed / repeats, 1e-6)
-        repeats = max(repeats * 2, int(8 * rtt / per_rep) + 1)
+        repeats = min(max(repeats * 2, int(8 * rtt / per_rep) + 1), 4096)
     raise RuntimeError(
         f"timed region ({elapsed * 1e3:.1f} ms over {ran} repeats, RTT "
         f"{rtt * 1e3:.1f} ms) never exceeded the readback RTT after repeat "

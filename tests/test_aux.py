@@ -247,7 +247,10 @@ def test_two_process_distributed_smoke(tmp_path):
                 p.kill()
     for rank, out in enumerate(outs):
         line = [l for l in out.splitlines() if l.startswith("RESULT")][0]
-        _, nproc, pid, gathered = line.split()
+        # First four tokens only: under load, a worker's async log line can
+        # interleave onto the tail of the RESULT line (observed once in a
+        # loaded full-suite run) — the leading fields are still intact.
+        _, nproc, pid, gathered = line.split()[:4]
         assert nproc == "2" and pid == str(rank)
         assert gathered == "0,1"  # the collective saw BOTH processes
 

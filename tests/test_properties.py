@@ -103,9 +103,13 @@ def test_pick_bb_divides_batch_and_respects_budget(n, rows, cin, cout, taps, esz
     """The conv grid invariant: bb divides n; and the modeled scoped
     footprint of the chosen block stays within the VMEM budget whenever
     even a single image fits it (bb=1 is the documented floor)."""
-    bb = pc._pick_bb(n, rows, cin, cout, taps, esz, 4)
+    w_bytes = taps * cin * cout * 4
+    bb = pc._pick_bb(
+        n, rows, [cin], [cin] * taps, [cout], esz, esz, w_bytes
+    )
     assert 1 <= bb <= n and n % bb == 0
-    per_img = rows * (esz * (2 * (cin + cout) + taps * cin) + 4 * 2 * cout)
-    w_bytes = 2 * taps * cin * cout * 4
-    if per_img + w_bytes <= pc._VMEM_BUDGET:
-        assert bb * per_img + w_bytes <= pc._VMEM_BUDGET
+    per_img = rows * (
+        esz * (2 * cin + taps * cin) + esz * 2 * cout + 4 * 2 * cout
+    )
+    if per_img + 2 * w_bytes <= pc._VMEM_BUDGET:
+        assert bb * per_img + 2 * w_bytes <= pc._VMEM_BUDGET
