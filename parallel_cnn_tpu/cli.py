@@ -50,6 +50,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "train step")
     p.add_argument("--accum-steps", type=int, default=1,
                    help="zoo models only: gradient-accumulation microbatches")
+    p.add_argument("--zoo-loader", default="device",
+                   choices=["device", "native"],
+                   help="zoo models only: batch source — on-device gathers "
+                        "over the HBM-resident dataset, or the native C++ "
+                        "prefetch ring (data/native.py; NumPy-twin fallback "
+                        "without a toolchain)")
     p.add_argument("--loader", default=d.loader,
                    choices=["auto", "native", "numpy", "synthetic"])
     p.add_argument("--data-dir", default=None,
@@ -307,6 +313,7 @@ def _run_zoo(args: argparse.Namespace, cfg: Config) -> int:
         checkpoint_dir=args.checkpoint_dir,
         resume=args.resume,
         metrics=metrics,
+        loader=args.zoo_loader,
     )
     if metrics:
         metrics.close()

@@ -163,6 +163,21 @@ def test_cli_zoo_model(tmp_path):
     assert any(rec.get("event") == "zoo_epoch" for rec in recs)
 
 
+def test_cli_zoo_native_loader():
+    """--zoo-loader native feeds the zoo trainer from the C++ prefetch
+    ring through the CLI (round 4: the data runtime at zoo shapes)."""
+    r = _run_cli([
+        "--model", "cifar_cnn",
+        "--epochs", "1",
+        "--batch-size", "32",
+        "--synthetic-train-count", "96",
+        "--synthetic-test-count", "32",
+        "--zoo-loader", "native",
+    ])
+    assert r.returncode == 0, r.stderr
+    assert "epoch 1: loss" in r.stdout
+
+
 @pytest.mark.slow
 def test_cli_mesh_training(tmp_path):
     """--mesh-data/--mesh-model drive learn() over the 8-device CPU mesh
