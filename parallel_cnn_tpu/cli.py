@@ -27,7 +27,7 @@ def build_parser() -> argparse.ArgumentParser:
     d, t = DataConfig(), TrainConfig()
     p.add_argument("--model", default="lenet_ref",
                    choices=["lenet_ref", "cifar_cnn", "resnet18", "resnet34",
-                            "resnet50"],
+                            "resnet50", "vgg16"],
                    help="lenet_ref = the reference-parity trainer; the rest "
                         "route to the model-zoo trainer (train/zoo.py, "
                         "synthetic CIFAR-shape data, SGD+momentum)")
@@ -239,7 +239,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
 
 def _run_zoo(args: argparse.Namespace, cfg: Config) -> int:
-    """Zoo-model driver branch (--model {cifar_cnn,resnet18,34,50}).
+    """Zoo-model driver branch (--model {cifar_cnn,resnet18,34,50,vgg16}).
 
     Trains on the deterministic synthetic CIFAR-shape stand-in (this
     environment cannot fetch CIFAR/ImageNet — BASELINE.md), with the
@@ -248,7 +248,7 @@ def _run_zoo(args: argparse.Namespace, cfg: Config) -> int:
     --mesh-data mesh, and --conv-backend pallas for the native kernels.
     """
     from parallel_cnn_tpu.data import synthetic
-    from parallel_cnn_tpu.nn import cifar, resnet
+    from parallel_cnn_tpu.nn import cifar, resnet, vgg
     from parallel_cnn_tpu.parallel import mesh as mesh_lib
     from parallel_cnn_tpu.train import zoo
     from parallel_cnn_tpu.utils.metrics import MetricsLogger
@@ -264,9 +264,12 @@ def _run_zoo(args: argparse.Namespace, cfg: Config) -> int:
         "resnet50": lambda: resnet.resnet50(
             10, cifar_stem=True, conv_backend=args.conv_backend
         ),
+        "vgg16": lambda: vgg.vgg16(10, conv_backend=args.conv_backend),
     }
     if cfg.model == "cifar_cnn" and args.conv_backend != "xla":
-        raise SystemExit("--conv-backend pallas applies to the resnet models")
+        raise SystemExit(
+            "--conv-backend pallas applies to the resnet/vgg models"
+        )
     if args.mesh_model not in (None, 1):
         raise SystemExit(
             "zoo models parallelize via GSPMD data parallelism only "

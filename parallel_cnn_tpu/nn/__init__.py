@@ -27,4 +27,4 @@ from parallel_cnn_tpu.nn.layers import (  # noqa: F401
     MaxPool,
     ReLU,
 )
-from parallel_cnn_tpu.nn import cifar, resnet  # noqa: F401
+from parallel_cnn_tpu.nn import cifar, resnet, vgg  # noqa: F401

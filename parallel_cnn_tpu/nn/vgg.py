@@ -1,0 +1,62 @@
+"""VGG (Simonyan & Zisserman 2014) for the model zoo — the classic
+plain-conv family alongside the ResNets, built from the same NHWC layer
+library. Configuration D (VGG-16): thirteen 3×3 SAME convs in five
+maxpooled stages, then the classifier.
+
+Two heads, as is conventional:
+- ``cifar_head=True`` (default): GlobalAvgPool → Dense(num_classes) —
+  the compact adaptation every CIFAR recipe uses.
+- ``cifar_head=False``: the original Flatten → 4096 → 4096 → classes
+  MLP (param parity with torchvision ``vgg16``/``vgg16_bn`` — asserted
+  in tests/test_zoo.py; dropout is omitted: it carries no parameters
+  and the zoo's regularizer is augmentation + weight decay).
+
+Convs keep bias=True even under BatchNorm, matching torchvision's VGG
+so the parameter counts line up exactly. conv_backend="pallas" routes
+every conv through the hand-written kernels (ops/pallas_conv.py — all
+3×3 stride-1, the kernel family's cheapest case).
+"""
+
+from __future__ import annotations
+
+from parallel_cnn_tpu.nn.core import Sequential
+from parallel_cnn_tpu.nn.layers import (
+    BatchNorm,
+    Conv2D,
+    Dense,
+    Flatten,
+    GlobalAvgPool,
+    MaxPool,
+    ReLU,
+)
+
+# Configuration D: channels per conv, "M" = 2×2 maxpool.
+_VGG16 = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+          512, 512, 512, "M", 512, 512, 512, "M"]
+
+
+def vgg16(
+    num_classes: int = 10,
+    batch_norm: bool = True,
+    cifar_head: bool = True,
+    conv_backend: str = "xla",
+) -> Sequential:
+    layers = []
+    for v in _VGG16:
+        if v == "M":
+            layers.append(MaxPool(window=(2, 2), strides=(2, 2)))
+            continue
+        layers.append(Conv2D(v, backend=conv_backend))
+        if batch_norm:
+            layers.append(BatchNorm())
+        layers.append(ReLU())
+    if cifar_head:
+        layers += [GlobalAvgPool(), Dense(num_classes)]
+    else:
+        layers += [
+            Flatten(),
+            Dense(4096), ReLU(),
+            Dense(4096), ReLU(),
+            Dense(num_classes),
+        ]
+    return Sequential(layers)
