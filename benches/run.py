@@ -437,11 +437,13 @@ def bench_northstar(quick: bool) -> List[Row]:
 
 
 def bench_zoo(quick: bool) -> List[Row]:
-    """Model-zoo step throughput (BASELINE.json configs #3-#5): CIFAR CNN,
-    ResNet-18 (XLA convs and the Pallas conv-kernel backend), and
-    ResNet-50 at ImageNet shape with gradient accumulation."""
+    """Model-zoo step throughput (BASELINE.json configs #3-#5 + round-4
+    additions): CIFAR CNN, ResNet-18 and VGG-16 (XLA convs and the
+    Pallas conv-kernel backend), and ResNet-50 at ImageNet shape with
+    gradient accumulation — on TPU also with every conv (incl. the
+    7×7-s2 stem) on the Pallas kernels."""
     from parallel_cnn_tpu.data import synthetic
-    from parallel_cnn_tpu.nn import cifar, resnet
+    from parallel_cnn_tpu.nn import cifar, resnet, vgg
     from parallel_cnn_tpu.train import zoo
 
     rows = []
@@ -455,6 +457,7 @@ def bench_zoo(quick: bool) -> List[Row]:
         ("cifar_cnn", cifar.cifar_cnn(), cifar.IN_SHAPE, x, y, 1, 50),
         ("resnet18_cifar", resnet.resnet18(10, cifar_stem=True),
          cifar.IN_SHAPE, x, y, 1, 20),
+        ("vgg16_cifar", vgg.vgg16(10), cifar.IN_SHAPE, x, y, 1, 10),
     ]
     from parallel_cnn_tpu.utils.backend import canonical_platform
 
@@ -464,6 +467,11 @@ def bench_zoo(quick: bool) -> List[Row]:
         cases.append(
             ("resnet18_cifar_pallasconv",
              resnet.resnet18(10, cifar_stem=True, conv_backend="pallas"),
+             cifar.IN_SHAPE, x, y, 1, 10)
+        )
+        cases.append(
+            ("vgg16_cifar_pallasconv",
+             vgg.vgg16(10, conv_backend="pallas"),
              cifar.IN_SHAPE, x, y, 1, 10)
         )
     # Config #5: ResNet-50 at ImageNet shape (synthetic stand-in — no
@@ -476,10 +484,20 @@ def bench_zoo(quick: bool) -> List[Row]:
     imgs50, labels50 = synthetic.make_image_dataset(
         b50, hw=in50[:2], classes=100, seed=2
     )
+    x50, y50 = jnp.asarray(imgs50), jnp.asarray(labels50)
     cases.append(
         ("resnet50_imagenet_accum4", resnet.resnet50(100, cifar_stem=False),
-         in50, jnp.asarray(imgs50), jnp.asarray(labels50), 4, 5)
+         in50, x50, y50, 4, 5)
     )
+    if canonical_platform() == "tpu":
+        # Round 4: every ResNet-50 conv — 7×7-s2 stem included — on the
+        # hand-written kernels ("entire network" at the reference's own
+        # framing, PDF Table 8). TPU-only: ~60 Mosaic compiles.
+        cases.append(
+            ("resnet50_imagenet_accum4_pallasconv",
+             resnet.resnet50(100, cifar_stem=False, conv_backend="pallas"),
+             in50, x50, y50, 4, 3)
+        )
     for name, model, in_shape, bx, by, accum, reps in cases:
         bsz = bx.shape[0]
         opt = zoo.make_optimizer(0.05)

@@ -113,3 +113,67 @@ def test_pick_bb_divides_batch_and_respects_budget(n, rows, cin, cout, taps, esz
     )
     if per_img + 2 * w_bytes <= pc._VMEM_BUDGET:
         assert bb * per_img + 2 * w_bytes <= pc._VMEM_BUDGET
+
+
+@settings(max_examples=100, deadline=None)
+@given(
+    k=st.sampled_from([3, 5, 7]),
+    h=st.integers(2, 40),
+    w=st.integers(2, 40),
+)
+def test_s1_tap_layout_slice_legality(k, h, w):
+    """The pad-H-only layout invariants every stride-1 kernel relies on:
+    with rows = (Ttop+h+Tbot)·w, center [lo, nb-tail), every tap slice
+    [lo+off, hi+off) stays inside an nb-row block, real rows are inside
+    the center region, and semantically-zero reads land on pad rows."""
+    taps = pc._s1_taps(k, w)
+    flat = [a * w + b for a, b, _ in taps]
+    rows, t_top, lo, tail = pc._layout(h, w, flat)
+    t_bot = rows // w - h - t_top
+    assert t_top >= 0 and t_bot >= 0
+    nb = 3 * rows  # any multiple: block = bb images
+    hi = nb - tail
+    assert 0 <= lo + min(flat) and hi + max(flat) <= nb
+    # real rows of every image in the block sit inside [lo, hi)
+    for img in range(3):
+        first = img * rows + t_top * w
+        last = img * rows + (t_top + h) * w - 1
+        assert lo <= first and last < hi
+    # semantically-zero reads land on the image's OWN pad rows: a tap
+    # read from any real row never reaches outside this image's padded
+    # span (where it could alias a neighbor's real data)
+    assert t_top * w + min(flat) >= 0
+    assert (t_top + h) * w - 1 + max(flat) < rows
+
+
+@settings(max_examples=100, deadline=None)
+@given(
+    k=st.sampled_from([3, 5, 7]),
+    oy=st.integers(0, 5),
+    ox=st.integers(0, 5),
+)
+def test_s2_phase_taps_match_conv_index_equation(k, oy, ox):
+    """Derive both mappings INDEPENDENTLY from the stride-2 SAME conv
+    index equation u = 2·o + d − pad_lo (pad_lo = (k−2)//2, XLA's even-dim
+    placement) and check _s2_phase_taps against it — forward: tap (dy,dx)
+    at output (oy,ox) must read phase (u%2, v%2) at phase-pixel
+    (u//2, v//2); inverse (dgrad): the same tap must route that
+    contribution from dout(oy,ox) back onto the dx-output phase of the
+    input pixel it consumed, at the offset that reconstructs (oy,ox)."""
+    pl = (k - 2) // 2
+    fwd = {slot: (ph, a, b) for ph, a, b, slot in pc._s2_phase_taps(k)}
+    inv = {slot: (ph, a, b) for ph, a, b, slot in
+           pc._s2_phase_taps(k, inverse=True)}
+    assert set(fwd) == set(inv) == set(range(k * k))
+    for dy in range(k):
+        for dx in range(k):
+            slot = dy * k + dx
+            u, v = 2 * oy + dy - pl, 2 * ox + dx - pl  # input pixel read
+            fph, fa, fb = fwd[slot]
+            assert fph == (u % 2) * 2 + (v % 2)
+            assert (oy + fa, ox + fb) == (u // 2, v // 2)
+            iph, ia, ib = inv[slot]
+            # dgrad writes dx at input pixel (u,v): phase = its parity,
+            # phase-pixel (u//2, v//2), reading dout at (oy, ox)
+            assert iph == (u % 2) * 2 + (v % 2)
+            assert (u // 2 + ia, v // 2 + ib) == (oy, ox)
