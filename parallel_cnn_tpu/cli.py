@@ -100,7 +100,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics", default=None, metavar="PATH",
                    help="append JSONL metrics records to PATH")
     p.add_argument("--profile", action="store_true",
-                   help="print the per-phase table (paper Tables 4-8 shape)")
+                   help="lenet_ref: print the per-phase table (paper "
+                        "Tables 4-8 shape); zoo models: write a "
+                        "jax.profiler trace of 3 steady-state train steps "
+                        "to zoo_xla_trace/ under --checkpoint-dir (or cwd)")
     return p
 
 
@@ -317,6 +320,17 @@ def _run_zoo(args: argparse.Namespace, cfg: Config) -> int:
         resume=args.resume,
         metrics=metrics,
         loader=args.zoo_loader,
+        # Zoo --profile = a jax.profiler trace of 3 steady-state steps of
+        # THE run's own jitted step (augment/schedule/accum/mesh included;
+        # compile excluded) — the single-chip MFU attribution tool. The
+        # lenet path's --profile prints the per-phase table instead.
+        profile_trace_dir=(
+            os.path.abspath(
+                os.path.join(args.checkpoint_dir or ".", "zoo_xla_trace")
+            )
+            if args.profile
+            else None
+        ),
     )
     if metrics:
         metrics.close()

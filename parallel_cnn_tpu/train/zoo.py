@@ -282,6 +282,7 @@ def train(
     resume: bool = False,
     metrics=None,
     loader: str = "device",
+    profile_trace_dir: Optional[str] = None,
 ):
     """Epoch driver for zoo models on an in-memory dataset.
 
@@ -304,6 +305,12 @@ def train(
       horizontal flip, traced into the train step (data/augment.py);
       per-step keys derive from ``seed`` and the global step index, so
       the augmentation stream is also resume-reproducible.
+    - ``profile_trace_dir``: after training, capture a jax.profiler
+      trace of 3 steady-state steps of THE SAME jitted step the run
+      trained with (augmentation, schedule, accumulation, and mesh
+      included — no separate reconstruction that could drift), compile
+      excluded. Open in XProf/TensorBoard; this is the single-chip MFU
+      attribution tool.
     - ``loader``: "device" (default) keeps the dataset in HBM and gathers
       each shuffled batch on-device; "native" feeds batches from the C++
       prefetch ring (data/native.py — a worker thread assembles the next
@@ -426,4 +433,30 @@ def train(
                 f"epoch {epoch + 1}: loss {losses[-1]:.4f}{acc_txt} "
                 f"({seconds:.2f}s)"
             )
+
+    if profile_trace_dir:
+        from parallel_cnn_tpu.utils import profiling
+
+        bx = jnp.asarray(images[:batch_size])
+        by = jnp.asarray(labels[:batch_size])
+        total = epochs * steps
+
+        def pkey(i):
+            return (
+                jax.random.fold_in(aug_base, total + i)
+                if aug_fn is not None
+                else None
+            )
+
+        # One warm step outside the trace: the step is already compiled
+        # from training, but a resumed-at-final-epoch run may have taken
+        # zero steps in this process.
+        state, loss = step(state, bx, by, pkey(0))
+        jax.block_until_ready(loss)
+        with profiling.xla_trace(profile_trace_dir):
+            for i in range(1, 4):
+                state, loss = step(state, bx, by, pkey(i))
+            jax.block_until_ready(loss)
+        if verbose:
+            print(f"xla trace (3 steps) written to {profile_trace_dir}")
     return state, losses

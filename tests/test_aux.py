@@ -309,3 +309,24 @@ def test_two_process_distributed_smoke(tmp_path):
         trains2d.append([float(v) for v in line.split()[1].split(",")])
     assert trains2d[0] == trains2d[1]
     np.testing.assert_allclose(trains2d[0], ref_errs, rtol=1e-4)
+
+
+def test_cli_zoo_profile_writes_trace(tmp_path):
+    """Zoo --profile captures a jax.profiler trace of steady-state steps
+    (the MFU-attribution tool; lenet --profile prints the phase table)."""
+    ckpt = str(tmp_path / "zp")
+    r = _run_cli([
+        "--model", "cifar_cnn",
+        "--epochs", "1",
+        "--batch-size", "32",
+        "--synthetic-train-count", "64",
+        "--synthetic-test-count", "32",
+        "--checkpoint-dir", ckpt,
+        "--profile",
+    ])
+    assert r.returncode == 0, r.stderr
+    assert "xla trace (3 steps) written to" in r.stdout
+    import os as _os
+
+    trace_dir = _os.path.join(ckpt, "zoo_xla_trace")
+    assert _os.path.isdir(trace_dir) and _os.listdir(trace_dir)
