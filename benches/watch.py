@@ -1,0 +1,128 @@
+"""TPU relay watcher: fixed-interval probe, run the chip-time playbook on heal.
+
+The axon relay that fronts the TPU goes down for hours at a time (it ate
+the on-chip benchmark artifact in rounds 2-4). This watcher turns "hope a
+human notices the heal" into a process:
+
+    nohup python benches/watch.py --tag r5 >> docs/watch_r5.log 2>&1 &
+
+Loop: probe backend health in a subprocess (hard timeout, so a hung relay
+can never hang the watcher — same contract as bench.py's
+``_resolve_platform``); while the chip is down, re-probe every
+``--interval`` seconds (probes are cheap; outages last hours, so a fixed
+short interval loses at most minutes of healed-chip time). At the first
+heal run the FULL playbook (``benches/playbook.sh full``); once a full
+run completes cleanly, later heals re-run only the cheap headline step
+after ``--cooldown`` — lines append, and the driver headline is a median
+over same-session samples, so every extra run strengthens the artifact.
+A playbook run that fails (relay died mid-run, or only a CPU-fallback
+line was produced) is retried at ``--interval``, not ``--cooldown``:
+healed-chip windows are the scarce resource.
+
+Probe/run/sleep are injectable for tests (tests/test_watch.py mocks all
+three; no TPU or subprocess needed to verify the loop logic).
+
+Reference anchor: the reference committed measured numbers for every
+backend it shipped (README.md:17-18, PDF Tables 1-8); this is the tooling
+that keeps us able to do the same under an unreliable relay.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+_PROBE_SNIPPET = "import jax; print(jax.devices()[0].platform)"
+
+
+def probe_once(timeout: float = 120.0, runner=subprocess.run) -> bool:
+    """True iff a fresh process sees a non-CPU default jax backend.
+
+    A probe that *succeeds* but reports ``cpu`` (axon plugin loaded, no
+    TPU exposed) counts as down — that mode is exactly what produced the
+    CPU-fallback BENCH_r03/r04 artifacts.
+    """
+    try:
+        proc = runner(
+            [sys.executable, "-c", _PROBE_SNIPPET],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+    out = (proc.stdout or "").strip().splitlines()
+    platform = out[-1] if out else ""
+    return proc.returncode == 0 and bool(platform) and platform != "cpu"
+
+
+def watch(
+    *,
+    interval: float,
+    cooldown: float,
+    tag: str,
+    playbook: str,
+    max_runs: int = 0,
+    probe=probe_once,
+    run=subprocess.run,
+    sleep=time.sleep,
+) -> int:
+    """Poll until healthy, run the playbook, repeat. Returns #runs done."""
+
+    def _log(msg: str) -> None:
+        stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        print(f"[watch {stamp}] {msg}", flush=True)
+
+    runs = 0
+    probes = 0
+    full_done = False
+    while max_runs <= 0 or runs < max_runs:
+        probes += 1
+        if probe():
+            # Retry the FULL evidence set until one run completes cleanly
+            # (a relay that dies mid-run, or a CPU-fallback headline,
+            # exits the playbook nonzero); only then drop to the cheap
+            # headline repeats.
+            mode = "headline" if full_done else "full"
+            _log(f"chip healthy (probe {probes}); running playbook mode={mode}")
+            proc = run(["bash", playbook, mode, tag])
+            rc = getattr(proc, "returncode", 0)
+            if mode == "full" and rc == 0:
+                full_done = True
+            runs += 1
+            # A failed run re-probes at the short interval — the chip
+            # probably just died, and the next heal must not wait out a
+            # full cooldown.
+            delay = cooldown if rc == 0 else interval
+            _log(f"playbook run {runs} finished rc={rc}; next probe in {delay:.0f}s")
+            sleep(delay)
+        else:
+            _log(f"chip down (probe {probes}); retry in {interval:.0f}s")
+            sleep(interval)
+    return runs
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--tag", default=os.environ.get("PCNN_ROUND_TAG", ""),
+                        help="artifact tag (docs/bench_lines_<tag>.jsonl etc.)")
+    parser.add_argument("--interval", type=float, default=240.0,
+                        help="seconds between probes while the chip is down")
+    parser.add_argument("--cooldown", type=float, default=3600.0,
+                        help="seconds to wait after a successful playbook run")
+    parser.add_argument("--max-runs", type=int, default=0,
+                        help="stop after this many playbook runs (0 = forever)")
+    parser.add_argument("--playbook",
+                        default=os.path.join(os.path.dirname(__file__), "playbook.sh"))
+    args = parser.parse_args(argv)
+    tag = args.tag or time.strftime("%Y%m%d", time.gmtime())
+    watch(interval=args.interval, cooldown=args.cooldown, tag=tag,
+          playbook=args.playbook, max_runs=args.max_runs)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
