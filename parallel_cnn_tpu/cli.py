@@ -91,8 +91,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "mesh flag routes minibatch training over the "
                         "device mesh (≙ mpirun -np N, MPI/Main.cpp:44)")
     p.add_argument("--mesh-model", type=int, default=None, metavar="N",
-                   help="model (intra-op) mesh axis size; must divide the "
-                        "6 conv filters (legal: 1, 2, 3, 6)")
+                   help="model (intra-op) mesh axis size. lenet_ref: must "
+                        "divide the 6 conv filters (legal: 1, 2, 3, 6). "
+                        "zoo models: filter/channel GSPMD sharding "
+                        "(parallel/zoo_sharding.py) composed with "
+                        "--mesh-data DP on the 2-D mesh")
     p.add_argument("--checkpoint-dir", default=None,
                    help="save ckpt_<epoch>.npz per epoch; --resume restarts "
                         "from the latest")
@@ -248,7 +251,8 @@ def _run_zoo(args: argparse.Namespace, cfg: Config) -> int:
     environment cannot fetch CIFAR/ImageNet — BASELINE.md), with the
     production surface zoo.train provides: per-epoch eval, atomic
     checkpoint/resume of the FULL state, JSONL metrics, GSPMD DP over a
-    --mesh-data mesh, and --conv-backend pallas for the native kernels.
+    --mesh-data mesh (plus filter sharding with --mesh-model N>1), and
+    --conv-backend pallas for the native kernels.
     """
     from parallel_cnn_tpu.data import synthetic
     from parallel_cnn_tpu.nn import cifar, resnet, vgg
@@ -273,11 +277,6 @@ def _run_zoo(args: argparse.Namespace, cfg: Config) -> int:
         raise SystemExit(
             "--conv-backend pallas applies to the resnet/vgg models"
         )
-    if args.mesh_model not in (None, 1):
-        raise SystemExit(
-            "zoo models parallelize via GSPMD data parallelism only "
-            "(--mesh-data); --mesh-model is the lenet_ref intra-op path"
-        )
     model = factories[cfg.model]()
 
     imgs, labels = synthetic.make_image_dataset(
@@ -287,9 +286,16 @@ def _run_zoo(args: argparse.Namespace, cfg: Config) -> int:
         args.synthetic_test_count, seed=cfg.data.synthetic_seed + 1
     )
 
+    # Either mesh flag opts the zoo into GSPMD mesh training: --mesh-data
+    # alone is pure DP; --mesh-model N>1 additionally shards filters/
+    # channels (+ optimizer state + BN stats) over the model axis
+    # (parallel/zoo_sharding.py) — hybrid 2-D zoo training.
     mesh = None
-    if args.mesh_data is not None:
-        mesh = mesh_lib.make_mesh(MeshConfig(data=args.mesh_data, model=1))
+    model_axis = (args.mesh_model or 1) > 1
+    if args.mesh_data is not None or model_axis:
+        mesh = mesh_lib.make_mesh(
+            MeshConfig(data=args.mesh_data, model=args.mesh_model or 1)
+        )
         print(f"mesh: {dict(mesh.shape)}")
 
     metrics = MetricsLogger(path=args.metrics) if args.metrics else None
@@ -314,6 +320,7 @@ def _run_zoo(args: argparse.Namespace, cfg: Config) -> int:
         augment=args.augment,
         accum_steps=args.accum_steps,
         mesh=mesh,
+        model_axis=model_axis,
         seed=args.seed,
         eval_data=(ev_imgs, ev_labels),
         checkpoint_dir=args.checkpoint_dir,

@@ -1,0 +1,76 @@
+"""Model-axis (filter/channel) sharding specs for zoo models — GSPMD
+param partitioning over the mesh's ``model`` axis, composable with data
+parallelism on the same 2-D mesh.
+
+This extends the reference's per-kernel intra-op decomposition capability
+(MPI/layer.h:162-201 splits each kernel's output index space across
+ranks) beyond the fixed LeNet: for zoo models (ResNet/VGG/CIFAR CNN) the
+decomposed dimension is the conv *filter* (output-channel) dimension —
+each model-axis shard owns a slice of every layer's filters, the moral
+equivalent of giving each MPI rank a contiguous block of each kernel's
+output space, minus the reference's root-only reduce defect (B7).
+
+Mechanism: one PartitionSpec rule per parameter leaf (shard the trailing
+axis over ``model`` when divisible, else replicate) applied as GSPMD
+sharding constraints inside the jitted train step. XLA's partitioner
+then chooses the collectives (all-gathers at use sites, reduce-scatters
+in the backward) — the idiomatic TPU answer, vs. the reference's 16
+hand-placed MPI_Reduce sites. The optimizer state inherits the same rule,
+so momentum buffers shard with their parameters (the memory win the
+reference's replicated-everything MPI design never had).
+
+Trailing-axis-by-rule covers every zoo leaf correctly:
+- Conv ``w``   (kh, kw, cin, cout) → cout sharded  = filter sharding
+- Conv ``b``   (cout,)             → cout sharded
+- BatchNorm scale/bias/mean/var (c,) → channel sharding
+- Dense ``w`` (d, features)        → features sharded (column parallel)
+- scalars / non-divisible leaves (e.g. a 10-class head on a 4-wide
+  model axis) → replicated, by the divisibility guard.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from parallel_cnn_tpu.parallel.mesh import MODEL_AXIS
+
+
+def leaf_spec(leaf: Any, model_size: int) -> P:
+    """PartitionSpec for one param/state leaf: trailing axis over
+    ``model`` when evenly divisible, replicated otherwise."""
+    shape = getattr(leaf, "shape", ())
+    if len(shape) >= 1 and shape[-1] % model_size == 0 and shape[-1] > 0:
+        return P(*([None] * (len(shape) - 1) + [MODEL_AXIS]))
+    return P()
+
+
+def specs(tree: Any, mesh: Mesh):
+    """Pytree of NamedShardings mirroring ``tree`` (host-side placement
+    and inspection; the in-step twin is `constrain`)."""
+    m = mesh.shape[MODEL_AXIS]
+    return jax.tree_util.tree_map(
+        lambda leaf: NamedSharding(mesh, leaf_spec(leaf, m)), tree
+    )
+
+
+def constrain(tree: Any, mesh: Mesh):
+    """Apply the leaf rule as GSPMD sharding constraints (traceable —
+    call inside jit)."""
+    m = mesh.shape[MODEL_AXIS]
+    return jax.tree_util.tree_map(
+        lambda leaf: jax.lax.with_sharding_constraint(
+            leaf, NamedSharding(mesh, leaf_spec(leaf, m))
+        ),
+        tree,
+    )
+
+
+def shard_params(tree: Any, mesh: Mesh):
+    """Place a host/replicated pytree onto the mesh under the leaf rule
+    (initial placement; ≙ mesh.replicate but model-axis-sharded)."""
+    return jax.tree_util.tree_map(
+        lambda leaf, s: jax.device_put(leaf, s), tree, specs(tree, mesh)
+    )

@@ -103,6 +103,7 @@ def make_train_step(
     accum_steps: int = 1,
     mesh: Optional[Mesh] = None,
     augment: Optional[Callable] = None,
+    model_axis: bool = False,
 ) -> Callable:
     """Build the jitted train step: (state, x, y) -> (state, loss), or
     (state, x, y, key) -> (state, loss) when `augment` is given.
@@ -115,7 +116,15 @@ def make_train_step(
     runs on-device inside the same jitted program, after the sharding
     constraint — so under a mesh each device augments only its own batch
     shard.
+
+    model_axis=True additionally shards params, optimizer state, and BN
+    running stats over the mesh's ``model`` axis by the filter/channel
+    rule (parallel/zoo_sharding.py) — hybrid DP×model-parallel training
+    on the 2-D mesh, the zoo-scale extension of the reference's per-kernel
+    intra-op decomposition (MPI/layer.h:162-201). Requires ``mesh``.
     """
+    if model_axis and mesh is None:
+        raise ValueError("model_axis=True requires a mesh")
 
     def loss_fn(params, model_state, x, y):
         logits, new_state = model.apply(params, model_state, x, train=True)
@@ -175,17 +184,29 @@ def make_train_step(
             data_sh = NamedSharding(mesh, P(DATA_AXIS))
             x = jax.lax.with_sharding_constraint(x, data_sh)
             y = jax.lax.with_sharding_constraint(y, data_sh)
-            # Pin params replicated so the gradient all-reduce lands over
-            # the data axis even under future multi-axis meshes.
-            repl = NamedSharding(mesh, P())
-            state = ZooState(
-                jax.tree_util.tree_map(
-                    lambda p: jax.lax.with_sharding_constraint(p, repl),
-                    state.params,
-                ),
-                state.model_state,
-                state.opt_state,
-            )
+            if model_axis:
+                # Filter/channel sharding over the model axis for params,
+                # optimizer state AND BN running stats; grads/updates
+                # inherit the layout, XLA places the collectives.
+                from parallel_cnn_tpu.parallel import zoo_sharding
+
+                state = ZooState(
+                    zoo_sharding.constrain(state.params, mesh),
+                    zoo_sharding.constrain(state.model_state, mesh),
+                    zoo_sharding.constrain(state.opt_state, mesh),
+                )
+            else:
+                # Pin params replicated so the gradient all-reduce lands
+                # over the data axis even under future multi-axis meshes.
+                repl = NamedSharding(mesh, P())
+                state = ZooState(
+                    jax.tree_util.tree_map(
+                        lambda p: jax.lax.with_sharding_constraint(p, repl),
+                        state.params,
+                    ),
+                    state.model_state,
+                    state.opt_state,
+                )
         if augment is not None:
             x = augment(key, x)
         loss, model_state, grads = microbatch_grads(
@@ -274,6 +295,7 @@ def train(
     augment_pad: int = 4,
     accum_steps: int = 1,
     mesh: Optional[Mesh] = None,
+    model_axis: bool = False,
     seed: int = 0,
     verbose: bool = True,
     eval_data: Optional[Tuple[Any, Any]] = None,
@@ -324,6 +346,10 @@ def train(
       bit-identical NumPy twin (pipeline.native_semantics_batches) when
       the C++ toolchain is unavailable — same batches either way.
 
+    - ``model_axis=True`` (requires ``mesh``): filter/channel sharding
+      of params/optimizer/BN stats over the mesh's ``model`` axis
+      (parallel/zoo_sharding.py) composed with DP — hybrid 2-D training.
+
     Returns (ZooState, list of per-epoch mean losses).
     """
     if loader not in ("device", "native"):
@@ -347,7 +373,9 @@ def train(
         def aug_fn(key, x):
             return aug_lib.random_crop_flip(key, x, pad=augment_pad)
 
-    step = make_train_step(model, optimizer, accum_steps, mesh, aug_fn)
+    step = make_train_step(
+        model, optimizer, accum_steps, mesh, aug_fn, model_axis=model_axis
+    )
     ev_step = make_eval_step(model) if eval_data is not None else None
 
     start_epoch = 0
