@@ -28,11 +28,13 @@ def _he_normal(key, shape, fan_in, dtype):
 class Conv2D(Module):
     """features × (kh, kw) conv, stride/padding configurable, He init.
 
-    backend="pallas" routes supported shapes (3×3/1×1, stride 1/2, SAME)
-    through the hand-written tapped-matmul kernels in ops/pallas_conv.py —
-    the zoo's native-kernel path (BASELINE.json config #4). Unsupported
-    shapes raise at construction-use time rather than silently falling
-    back, so a "pallas" model is what it claims to be.
+    backend="pallas" routes supported shapes (square odd k ∈ {1,3,5,7},
+    stride 1/2, SAME — every conv in the ResNet and VGG families, 7×7-s2
+    stem included) through the hand-written tapped-matmul kernels in
+    ops/pallas_conv.py — the zoo's native-kernel path (BASELINE.json
+    config #4). Unsupported shapes raise at construction-use time rather
+    than silently falling back, so a "pallas" model is what it claims
+    to be.
     """
 
     features: int

@@ -187,7 +187,18 @@ def _pick_bb(
     """Images per grid step under the VMEM model: double-buffered in/out
     pipeline blocks, Mosaic's materialized per-tap slice copies (input
     dtype), f32 accumulator + per-tap dot result, minus the
-    double-buffered weight block."""
+    double-buffered weight block.
+
+    Mosaic tiling constraint (r5 on-chip finding — interpret-mode tests
+    can't catch it): a block's SUBLANE dim (bb·rows) must be a multiple
+    of the dtype's sublane tile — 32/itemsize, i.e. 8 for f32, 16 for
+    bf16 — unless the block spans the whole array (bb == n). With odd
+    rows (e.g. ResNet-50's 224²-input deep blocks: 9·7 = 63 flat rows
+    per image) a VMEM-picked bb of 4 yields a rejected 252-row block.
+    The in- and out-blocks share the bb·rows sublane dim at their own
+    dtypes, so the strictest (smallest-itemsize) tile governs. Pick the
+    largest legal divisor under the VMEM target, else the smallest legal
+    one above it (bb == n is always legal)."""
     cout = sum(couts)
     per_img = rows * (
         esz * (2 * sum(cins) + sum(tap_cins))
@@ -195,7 +206,14 @@ def _pick_bb(
         + 4 * 2 * cout
     )
     avail = _VMEM_BUDGET - 2 * w_bytes
-    return _batch_block(n, max(1, avail // max(per_img, 1)))
+    want = max(1, avail // max(per_img, 1))
+    tile = 32 // min(esz, out_esz)
+    legal = [
+        d for d in range(1, n + 1)
+        if n % d == 0 and ((d * rows) % tile == 0 or d == n)
+    ]
+    below = [d for d in legal if d <= want]
+    return max(below) if below else min(legal)
 
 
 def _compiler_params():
@@ -274,6 +292,11 @@ def _tapped_wgrad(
     cout = g_flat.shape[1]
     cin = cins[0]
     tap_cins = [cins[r] for (r, _, _, _) in taps]
+    # VMEM model note: g appears in BOTH the input list (cins + [cout])
+    # and the f32-accumulator term ([cout]) — in wgrad g is an input, so
+    # the [cout] accumulator it models does not exist. The overcount is
+    # intentional slack (picks a smaller bb than strictly needed, never a
+    # too-large one); round-4 advisor finding, kept as-is by choice.
     bb = _pick_bb(
         n, rows_per_img, cins + [cout], tap_cins, [cout],
         x_flats[0].dtype.itemsize, 4,
@@ -551,13 +574,14 @@ def _forward(x, w, stride):
         return _conv_s1(x, w)
     if x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0:
         return _conv_s2_even(x, w)
-    if k != 3:
-        raise ValueError(
-            f"pallas conv: stride-2 k={k} needs even spatial dims, got "
-            f"{x.shape[1]}×{x.shape[2]}"
-        )
     # Odd spatial dims at stride 2 (no zoo model hits this): stride-1 +
-    # subsample at XLA's window phase.
+    # subsample at XLA's window phase. k-generic: for SAME padding with
+    # odd k, pad_top(stride1) − pad_top(stride2) is 0 on odd dims and 1
+    # on even dims for EVERY odd k ≥ 3 (pad_total is k−1 vs k−1 / k−2),
+    # which is exactly _s2_offsets' per-dim formula — so the fallback
+    # covers k ∈ {3, 5, 7} alike (closes the supports()/apply gap the
+    # round-4 advisor flagged: supports() said yes for k>3 stride-2 but
+    # this path raised on odd dims).
     o = _conv_s1(x, w)
     oy, ox = _s2_offsets(x.shape[1], x.shape[2], k)
     return o[:, oy::2, ox::2, :]

@@ -30,6 +30,8 @@ CASES = [
     (2, 8, 8, 4, 8, 5, 2),
     (2, 12, 8, 3, 8, 7, 1),  # k=7 (ResNet-50 stem family)
     (2, 12, 8, 3, 8, 7, 2),  # ≙ 7×7-stride-2 stem at even dims
+    (2, 7, 8, 3, 6, 5, 2),   # k=5 stride-2 ODD/mixed dims (r5: the
+    (2, 9, 7, 3, 6, 7, 2),   # s1+subsample fallback is k-generic)
 ]
 
 
@@ -116,11 +118,13 @@ def test_conv2d_unsupported_shape_raises():
     params, state, _ = layer.init(jax.random.key(0), (16, 16, 3))
     with pytest.raises(ValueError, match="pallas conv backend"):
         layer.apply(params, state, jnp.zeros((1, 16, 16, 3)))
-    # stride-2 k>3 needs even spatial dims (ops/pallas_conv._forward)
+    # r5: stride-2 k>3 at ODD spatial dims no longer raises — the
+    # s1+phase-subsample fallback is k-generic, so everything supports()
+    # admits now actually runs (closes the r4 supports()/apply gap).
     layer7 = Conv2D(8, kernel=(7, 7), strides=(2, 2), backend="pallas")
     p7, s7, _ = layer7.init(jax.random.key(0), (15, 16, 3))
-    with pytest.raises(ValueError, match="even spatial dims"):
-        layer7.apply(p7, s7, jnp.zeros((1, 15, 16, 3)))
+    y, _ = layer7.apply(p7, s7, jnp.zeros((1, 15, 16, 3)))
+    assert y.shape == (1, 8, 8, 8)
 
 
 def test_resnet18_pallas_backend_step_matches_xla():
@@ -178,3 +182,23 @@ def test_resnet50_pallas_backend_forward_matches_xla():
         logits[backend] = np.asarray(out)
 
     np.testing.assert_allclose(logits["xla"], logits["pallas"], atol=5e-3)
+
+
+def test_pick_bb_sublane_rule():
+    """Mosaic requires block sublane dims (bb·rows) to be a multiple of
+    the dtype's sublane tile (8 for f32, 16 for bf16) unless the block
+    spans the array (r5 on-chip finding: ResNet-50's 224²-input deep
+    blocks have 63 flat rows/img; the VMEM-picked bb=4 gave a rejected
+    252-row block). Interpret mode can't catch this — pin the picker."""
+    for esz, out_esz, tile in [(4, 4, 8), (2, 4, 16), (2, 2, 16)]:
+        for n, rows in [(16, 63), (512, 34), (512, 17), (12, 5), (7, 3)]:
+            bb = pallas_conv._pick_bb(
+                n, rows, [512], [512] * 9, [512], esz, out_esz, 0
+            )
+            assert n % bb == 0
+            assert (bb * rows) % tile == 0 or bb == n, \
+                (esz, out_esz, n, rows, bb)
+    # Even-rows geometry keeps a VMEM-sized block (no behavior change
+    # for the shapes every CIFAR model uses).
+    bb = pallas_conv._pick_bb(512, 34, [64], [64] * 9, [64], 4, 4, 0)
+    assert (bb * 34) % 8 == 0 and bb > 1
