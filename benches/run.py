@@ -572,7 +572,11 @@ def main(argv=None) -> int:
             print(f"[{fn.__name__}] done", flush=True)
         except Exception as e:  # noqa: BLE001 — converted to a labeled row
             rows.append(Row(f"error_{fn.__name__}", -1.0, "error",
-                            None, f"{type(e).__name__}: {e}"))
+                            baseline=None,
+                            # Error text rides the baseline-source column
+                            # (render_md prints it where a baseline would
+                            # go) — deliberate column reuse, not a typo.
+                            baseline_src=f"{type(e).__name__}: {e}"))
             print(f"[{fn.__name__}] FAILED: {e}", flush=True)
 
     print(render_md(rows))

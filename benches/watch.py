@@ -93,6 +93,9 @@ def watch(
             if mode == "full" and rc == 0:
                 full_done = True
             runs += 1
+            if max_runs > 0 and runs >= max_runs:
+                _log(f"playbook run {runs} finished rc={rc}; max runs reached")
+                break
             # A failed run re-probes at the short interval — the chip
             # probably just died, and the next heal must not wait out a
             # full cooldown.

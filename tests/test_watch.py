@@ -69,7 +69,9 @@ class TestWatchLoop:
         # First heal runs the FULL playbook; later heals the cheap headline.
         assert calls == [["bash", "pb.sh", "full", "rX"],
                          ["bash", "pb.sh", "headline", "rX"]]
-        assert sleeps == [99.0, 99.0]  # cooldown after each CLEAN run
+        # Cooldown after each clean run EXCEPT the last (max-runs exit is
+        # immediate — no pointless trailing hour of sleep).
+        assert sleeps == [99.0]
 
     def test_sleeps_interval_while_down_then_runs(self):
         health = iter([False, False, True])
@@ -83,8 +85,8 @@ class TestWatchLoop:
             sleep=sleeps.append,
         )
         assert n == 1
-        assert sleeps == [7.0, 7.0, 50.0]
-        assert calls == [["bash", "pb.sh", "full", "t"]]
+        assert sleeps == [7.0, 7.0]  # down-probe intervals only; no
+        assert calls == [["bash", "pb.sh", "full", "t"]]  # trailing sleep
 
     def test_failed_full_run_is_retried_until_clean(self):
         # A full run that dies mid-way (relay drops, playbook exits
@@ -105,7 +107,9 @@ class TestWatchLoop:
         )
         assert n == 4
         assert [c[2] for c in calls] == ["full", "full", "full", "headline"]
-        assert sleeps == [7.0, 7.0, 99.0, 99.0]
+        # interval after each failed run, cooldown after the clean full,
+        # immediate exit after the final run.
+        assert sleeps == [7.0, 7.0, 99.0]
 
     def test_headline_failure_does_not_kill_watcher(self):
         rcs = iter([0, 1, 0])
