@@ -113,6 +113,31 @@ def _vpu_conv_kernel(w_ref, x_ref, o_ref):
         o_ref[m] = acc
 
 
+def _mxu_conv_3d_kernel(w_ref, x_ref, o_ref):
+    # (6,25) @ (25,BB,576) → (6,BB,576): rank-2 × rank-3 contraction, NO
+    # batch dims and NO reshape — if Mosaic lowers this, the megakernel's
+    # 150-FMA VPU conv loop swaps for one MXU dot with the SAME x layout
+    # it already stages (taps-major) and the SAME output layout the pool
+    # stage consumes. The r5 probes showed mxu-conv-L 7× faster than the
+    # VPU loop but lane-split REJECTED; this shape needs neither reshape.
+    o_ref[:] = lax.dot_general(
+        w_ref[:], x_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def probe_mxu_conv_3d():
+    w = jnp.ones((6, 25), jnp.float32)
+    x = jnp.ones((25, BB, 576), jnp.bfloat16)
+    return pl.pallas_call(
+        _mxu_conv_3d_kernel,
+        out_shape=jax.ShapeDtypeStruct((6, BB, 576), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024
+        ),
+    )(w, x)
+
+
 def probe_mxu_conv_L():
     w = jnp.ones((6, 25), jnp.float32)
     x = jnp.ones((25, L), jnp.bfloat16)
@@ -149,6 +174,7 @@ def main():
     _run("lane-split", probe_lane_split)
     _run("vpu-conv-baseline", probe_vpu_conv_baseline)
     _run("mxu-conv-L", probe_mxu_conv_L)
+    _run("mxu-conv-3d", probe_mxu_conv_3d)
     return 0
 
 

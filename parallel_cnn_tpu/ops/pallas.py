@@ -55,6 +55,7 @@ reference uses for l_s1.output → fp_preact_f (Sequential/layer.h:184-198).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Tuple
 
 import jax
@@ -87,6 +88,17 @@ def _interpret() -> bool:
 # on an XLA lowering detail — see fused_value_and_ref_grads). Monkeypatched
 # by test_fused_bf16_store_vs_f32_store to diff the two stores on-chip.
 _FORCE_X25_F32 = False
+
+# Forward-conv engine inside the fused megakernel: the r5 on-chip probes
+# (docs/mosaic_probe_r5.txt) measured a (6,25)@(25,·) MXU dot 7× faster
+# than the 150-FMA VPU loop, and the rank-2×rank-3 form
+# (6,25)@(25,Bb,576) → (6,Bb,576) needs NO relayout on either side — a
+# drop-in swap for the per-filter tap loop. Env-gated (read at import)
+# while the compiled lowering + parity are being established on-chip;
+# tests flip the module attribute via monkeypatch instead
+# (test_fused_mxu_conv_engine_matches — the kernel reads this global at
+# trace time, so a fresh jit after patching picks it up).
+_MXU_CONV = os.environ.get("PCNN_FUSED_MXU_CONV", "0") == "1"
 
 
 def _batch_block(n: int, want: int = 128) -> int:
@@ -622,15 +634,25 @@ def _fused_kernel(
         precision=lax.Precision.DEFAULT,
     )
 
-    # Forward: conv (25 tap-FMAs/filter) → pool (Mp matmul) → FC.
+    # Forward: conv → pool (Mp matmul) → FC. Conv engine: one
+    # (6,25)@(25,Bb,576) MXU dot when _MXU_CONV (r5 probe: 7× the VPU
+    # loop, same operand layouts), else 25 tap-FMAs/filter on the VPU.
     bb = y1h_ref.shape[0]
     outs_c1 = []
     outs_s1 = []
+    if _MXU_CONV:
+        x25 = x25_ref[:]
+        pre_c1 = dot(
+            w_c1_ref[:].astype(x25.dtype), x25, (((1,), (0,)), ((), ()))
+        )                                                       # (6, Bb, 576)
     pre_f = jnp.broadcast_to(b_f_ref[:], (bb, 10))
     for m in range(6):
-        acc = jnp.full((bb, 576), b_c1_ref[m, 0], f32)
-        for t in range(25):
-            acc += w_c1_ref[m, t] * x25_ref[t]
+        if _MXU_CONV:
+            acc = pre_c1[m] + b_c1_ref[m, 0]
+        else:
+            acc = jnp.full((bb, 576), b_c1_ref[m, 0], f32)
+            for t in range(25):
+                acc += w_c1_ref[m, t] * x25_ref[t]
         out_m = _sigmoid(acc)                                   # (Bb, 576)
         outs_c1.append(out_m)
         pre_s1_m = dot(out_m, mp, (((1,), (0,)), ((), ()))) + b_s1_ref[0, 0]

@@ -109,6 +109,19 @@ def test_staged_tier_matches_fused_tier(params, batch):
     tree_allclose(grads_s, grads_f, atol=1e-5)
 
 
+def test_fused_mxu_conv_engine_matches(params, batch, monkeypatch):
+    """The r5 MXU forward-conv engine ((6,25)@(25,Bb,576) dot, gated by
+    _MXU_CONV) must produce the same error/grads as the VPU tap-FMA
+    engine — the kernel reads the flag at trace time, so a fresh call
+    after the patch traces the dot variant."""
+    xs, ys = batch
+    err_v, grads_v = pk.fused_value_and_ref_grads(params, xs, ys)
+    monkeypatch.setattr(pk, "_MXU_CONV", True)
+    err_m, grads_m = pk.fused_value_and_ref_grads(params, xs, ys)
+    np.testing.assert_allclose(float(err_m), float(err_v), atol=1e-6)
+    tree_allclose(grads_m, grads_v, atol=1e-5)
+
+
 def test_fused_multi_grid_step_accumulation(monkeypatch):
     """Shrink FUSED_BLOCK so the fused tier runs a MULTI-step grid with a
     padded tail (grid=3 with 2 pad rows) — exercising the cross-grid-step
