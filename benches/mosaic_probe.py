@@ -162,6 +162,52 @@ def probe_vpu_conv_baseline():
     )(w, x)
 
 
+ROWS = 1024
+
+
+def _pair_dot_kernel(x_ref, w_ref, o_ref):
+    # K=64, N=128 dot then lane-halves add: the N-packing candidate for
+    # the zoo conv library's 64-channel stages (two taps' weights stacked
+    # along N, halves summed after row-shift). Probes whether Mosaic
+    # allows value slicing at a 64-lane offset (sub-lane-tile).
+    out = lax.dot_general(
+        x_ref[:], w_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[:] = out[:, :64] + out[:, 64:]
+
+
+def probe_pair_dot_laneslice():
+    x = jnp.ones((ROWS, 64), jnp.bfloat16)
+    w = jnp.ones((64, 128), jnp.bfloat16)
+    return pl.pallas_call(
+        _pair_dot_kernel,
+        out_shape=jax.ShapeDtypeStruct((ROWS, 64), jnp.float32),
+    )(x, w)
+
+
+def _two_dot_kernel(x_ref, w_ref, o_ref):
+    # the current formulation's shape: two separate K=N=64 dots
+    a = lax.dot_general(
+        x_ref[:], w_ref[:, :64], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    b = lax.dot_general(
+        x_ref[:], w_ref[:, 64:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[:] = a + b
+
+
+def probe_two_dot_baseline():
+    x = jnp.ones((ROWS, 64), jnp.bfloat16)
+    w = jnp.ones((64, 128), jnp.bfloat16)
+    return pl.pallas_call(
+        _two_dot_kernel,
+        out_shape=jax.ShapeDtypeStruct((ROWS, 64), jnp.float32),
+    )(x, w)
+
+
 def main():
     from parallel_cnn_tpu.utils.backend import is_tpu
 
@@ -175,6 +221,8 @@ def main():
     _run("vpu-conv-baseline", probe_vpu_conv_baseline)
     _run("mxu-conv-L", probe_mxu_conv_L)
     _run("mxu-conv-3d", probe_mxu_conv_3d)
+    _run("pair-dot-laneslice", probe_pair_dot_laneslice)
+    _run("two-dot-baseline", probe_two_dot_baseline)
     return 0
 
 

@@ -1,0 +1,99 @@
+"""ResNet-50 @224² single-chip MFU ablation (VERDICT r4 next #4).
+
+Attribution by ablation, not trace-parsing (the container's profile-
+plugin converter is version-broken): vary one axis at a time around the
+config-#5 operating point (batch 64, grad accumulation 4 → microbatch
+16, bf16 inputs) and read where the step time goes.
+
+    PYTHONPATH=. python benches/resnet50_ablate.py [--steps 6]
+
+Rows:
+  accum sweep  — b64 at accum {4, 2, 1}: unrolled-accumulation overhead
+                 + microbatch-size MXU effect in one axis.
+  dtype        — b64 accum4 with f32 inputs: the BN/elementwise dtype
+                 traffic lever (nn/layers.py normalizes at x.dtype).
+  batch 32     — accum {2, 1} at constant microbatch 16 vs 32.
+
+Each row is warmed (one step + full-pytree drain) then timed over
+--steps steps with the single full-drain barrier discipline
+(benches/run.py._drain hazard notes). OOM rows are labeled, not fatal.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 4.1e9  # fwd ≈4.1 GFLOP @224², train ≈3×
+PEAK_BF16 = 197e12
+
+
+def _drain(tree) -> None:
+    leaves = [l for l in jax.tree_util.tree_leaves(tree)
+              if hasattr(l, "block_until_ready")]
+    acc = None
+    for l in leaves:
+        s = jnp.sum(jnp.abs(l.astype(jnp.float32)))
+        acc = s if acc is None else acc + s
+    float(acc)
+
+
+def measure(batch, accum, dtype, steps):
+    from parallel_cnn_tpu.nn import resnet
+    from parallel_cnn_tpu.train import zoo
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(
+        rng.uniform(0, 1, (batch, 224, 224, 3)).astype(np.float32)
+    ).astype(dtype)
+    y = jnp.asarray(rng.integers(0, 100, (batch,)).astype(np.int32))
+    model = resnet.resnet50(100, cifar_stem=False)
+    opt = zoo.make_optimizer(0.05)
+    st = zoo.init_state(model, jax.random.key(0), (224, 224, 3), opt)
+    step = zoo.make_train_step(model, opt, accum_steps=accum)
+    st, _ = step(st, x, y)
+    _drain(st)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        st, _ = step(st, x, y)
+    _drain(st)
+    sec = (time.perf_counter() - t0) / steps
+    ips = batch / sec
+    mfu = RESNET50_TRAIN_FLOPS_PER_IMAGE * ips / PEAK_BF16
+    return ips, mfu, sec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=6)
+    args = ap.parse_args()
+    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    grid = [
+        ("b64_accum4_bf16 (config #5 operating point)", 64, 4, jnp.bfloat16),
+        ("b64_accum2_bf16 (microbatch 32)", 64, 2, jnp.bfloat16),
+        ("b64_accum1_bf16 (no accumulation)", 64, 1, jnp.bfloat16),
+        ("b64_accum4_f32 (dtype lever)", 64, 4, jnp.float32),
+        ("b32_accum2_bf16 (microbatch 16, half batch)", 32, 2, jnp.bfloat16),
+        ("b32_accum1_bf16 (microbatch 32, half batch)", 32, 1, jnp.bfloat16),
+    ]
+    print(f"| row | img/s | MFU | ms/step |")
+    print(f"|---|---|---|---|")
+    for name, b, a, dt in grid:
+        try:
+            ips, mfu, sec = measure(b, a, dt, args.steps)
+            print(f"| {name} | {ips:.1f} | {mfu * 100:.1f}% | "
+                  f"{sec * 1e3:.1f} |", flush=True)
+        except Exception as e:  # noqa: BLE001 — labeled, not fatal
+            print(f"| {name} | error | {type(e).__name__}: {e} | |"[:300],
+                  flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
