@@ -115,32 +115,110 @@ def _col_masks(taps_per_out, w_col: int, lo: int, hi: int):
     }
 
 
-def _tap_kernel(taps_per_out, w_col, lo, tail, n_in, *refs):
+def _plan_taps(entry):
+    """Flatten a plan entry back to (ridx, off, shift, slot) tap views
+    (slot unused) — lets _col_masks collect the shift set uniformly."""
+    if entry[0] == "s":
+        return [entry[1]]
+    _, ridx, off1, s1, off2, s2, _pslot = entry
+    return [(ridx, off1, s1, -1), (ridx, off2, s2, -1)]
+
+
+def _build_plan(taps_per_out, w_stack, cout):
+    """Greedily pair each output's taps (within a shared input ref) for
+    the N-packing path when 2·cout fits the 128-lane tile; returns
+    (plan_per_out, wp_stack or None). Odd taps stay single."""
+    if cout > 64:
+        return (
+            [[("s", t) for t in taps] for taps in taps_per_out],
+            None,
+        )
+    plans = []
+    pair_ws = []
+    for taps in taps_per_out:
+        plan = []
+        pending = {}
+        for t in taps:
+            r = t[0]
+            if r in pending:
+                t1 = pending.pop(r)
+                pslot = len(pair_ws)
+                pair_ws.append(
+                    jnp.concatenate(
+                        [w_stack[t1[3]], w_stack[t[3]]], axis=-1
+                    )
+                )
+                plan.append(("p", r, t1[1], t1[2], t[1], t[2], pslot))
+            else:
+                pending[r] = t
+        plan.extend(("s", t) for t in pending.values())
+        plans.append(plan)
+    if not pair_ws:
+        return plans, None
+    return plans, jnp.stack(pair_ws)
+
+
+def _tap_kernel(plan_per_out, w_col, lo, tail, n_in, have_pairs, *refs):
     """Generic multi-ref, multi-output tapped matmul.
 
-    refs = (x_ref_0..x_ref_{n_in-1}, w_ref, o_ref_0..). For each output,
-    acc = Σ_taps mask ⊙ (x_refs[ridx][lo+off : hi+off] @ w_ref[slot]).
+    refs = (x_ref_0..x_ref_{n_in-1}, w_ref[, wp_ref], o_ref_0..). Plan
+    entries per output:
+      ("s", (ridx, off, shift, slot))  —
+        acc += mask ⊙ (x_refs[ridx][lo+off : hi+off] @ w_ref[slot])
+      ("p", ridx, off1, s1, off2, s2, pslot)  —  N-PAIRED taps (r5,
+        the MXU K=N=64 attack): two taps sharing an input ref compute as
+        ONE dot against their weights stacked along N —
+        big = x_refs[ridx][0:nb] @ wp_ref[pslot]        (nb, 2·cout)
+        acc += mask1 ⊙ big[lo+off1 : hi+off1, :cout]
+             + mask2 ⊙ big[lo+off2 : hi+off2, cout:]
+        For cout ≤ 64 stages this doubles MXU lane fill (N 64 → 128) and
+        halves the dot count; the row shifts move to the CONSUMING
+        slices, which are free sublane slices. The 64-offset lane slice
+        is validated on-chip (mosaic_probe pair-dot-laneslice, r5).
     Rows outside [lo, hi) are pad/garbage rows the wrappers slice away —
     they are left unwritten. hi = nb - tail keeps every tap slice inside
-    the block.
+    the block, and pair dots read [0, nb) which covers every
+    [lo+off, hi+off) by the same invariant.
     """
     x_refs = refs[:n_in]
     w_ref = refs[n_in]
-    o_refs = refs[n_in + 1 :]
+    wp_ref = refs[n_in + 1] if have_pairs else None
+    o_refs = refs[n_in + 1 + (1 if have_pairs else 0):]
     nb = o_refs[0].shape[0]
     lo_, hi = lo, nb - tail
-    masks = _col_masks(taps_per_out, w_col, lo_, hi)
-    for o_ref, taps in zip(o_refs, taps_per_out):
+    masks = _col_masks(
+        [[t for e in plan for t in _plan_taps(e)] for plan in plan_per_out],
+        w_col, lo_, hi,
+    )
+    for o_ref, plan in zip(o_refs, plan_per_out):
+        cout = o_ref.shape[1]
         acc = None
-        for ridx, off, shift, slot in taps:
-            part = lax.dot_general(
-                x_refs[ridx][lo_ + off : hi + off, :],
-                w_ref[slot],
-                (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            if shift:
-                part = jnp.where(masks[shift], part, 0.0)
+        for entry in plan:
+            if entry[0] == "s":
+                ridx, off, shift, slot = entry[1]
+                part = lax.dot_general(
+                    x_refs[ridx][lo_ + off : hi + off, :],
+                    w_ref[slot],
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                if shift:
+                    part = jnp.where(masks[shift], part, 0.0)
+            else:
+                _, ridx, off1, s1, off2, s2, pslot = entry
+                big = lax.dot_general(
+                    x_refs[ridx][:, :],
+                    wp_ref[pslot],
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                p1 = big[lo_ + off1 : hi + off1, :cout]
+                if s1:
+                    p1 = jnp.where(masks[s1], p1, 0.0)
+                p2 = big[lo_ + off2 : hi + off2, cout:]
+                if s2:
+                    p2 = jnp.where(masks[s2], p2, 0.0)
+                part = p1 + p2
             acc = part if acc is None else acc + part
         o_ref[lo_:hi, :] = acc.astype(o_ref.dtype)
 
@@ -183,6 +261,7 @@ def _pick_bb(
     esz: int,
     out_esz: int,
     w_bytes: int,
+    pair_temps: int = 0,
 ) -> int:
     """Images per grid step under the VMEM model: double-buffered in/out
     pipeline blocks, Mosaic's materialized per-tap slice copies (input
@@ -204,6 +283,11 @@ def _pick_bb(
         esz * (2 * sum(cins) + sum(tap_cins))
         + out_esz * 2 * cout
         + 4 * 2 * cout
+        # N-pair packing (r5): each paired dot materializes a full-rows
+        # (nb, 2·cout) f32 `big`; count every pair as simultaneously
+        # live (conservative — Mosaic's scoped-stack accounting proved
+        # 1.7MB tighter than the pre-pairing model at the stem shape).
+        + 4 * 2 * max(couts, default=0) * pair_temps
     )
     avail = _VMEM_BUDGET - 2 * w_bytes
     want = max(1, avail // max(per_img, 1))
@@ -241,13 +325,32 @@ def _tapped_matmul(
         cins[ridx] for taps in taps_per_out for (ridx, _, _, _) in taps
     ]
     esz = x_flats[0].dtype.itemsize
+    # N-pair packing (r5): only when every output shares one cout ≤ 64 —
+    # then two taps ride one K×128 dot (see _tap_kernel's plan docs).
+    # Plan before picking bb: the pair temps count in the VMEM model.
+    if len(set(couts)) == 1:
+        plan_per_out, wp_stack = _build_plan(
+            taps_per_out, w_stack, couts[0]
+        )
+    else:
+        plan_per_out = [[("s", t) for t in taps] for taps in taps_per_out]
+        wp_stack = None
+    have_pairs = wp_stack is not None
+    max_pairs = max(
+        (sum(1 for e in plan if e[0] == "p") for plan in plan_per_out),
+        default=0,
+    )
     bb = _pick_bb(
         n, rows_per_img, cins, tap_cins, couts, esz,
         jnp.dtype(out_dtype).itemsize,
         w_stack.size * w_stack.dtype.itemsize,
+        pair_temps=max_pairs,
     )
+    w_inputs = [w_stack] + ([wp_stack] if have_pairs else [])
     outs = pl.pallas_call(
-        functools.partial(_tap_kernel, taps_per_out, w_col, lo, tail, n_in),
+        functools.partial(
+            _tap_kernel, plan_per_out, w_col, lo, tail, n_in, have_pairs
+        ),
         grid=(n // bb,),
         in_specs=[
             pl.BlockSpec(
@@ -256,8 +359,9 @@ def _tapped_matmul(
             )
             for c in cins
         ] + [
-            pl.BlockSpec(w_stack.shape, lambda g: (0,) * w_stack.ndim,
+            pl.BlockSpec(w.shape, lambda g, nd=w.ndim: (0,) * nd,
                          memory_space=pltpu.VMEM)
+            for w in w_inputs
         ],
         out_specs=[
             pl.BlockSpec(
@@ -272,7 +376,7 @@ def _tapped_matmul(
         ],
         interpret=_interpret(),
         compiler_params=_compiler_params(),
-    )(*x_flats, w_stack)
+    )(*x_flats, *w_inputs)
     return outs
 
 

@@ -47,30 +47,15 @@ def leaf_spec(leaf: Any, model_size: int) -> P:
     return P()
 
 
-def specs(tree: Any, mesh: Mesh):
-    """Pytree of NamedShardings mirroring ``tree`` (host-side placement
-    and inspection; the in-step twin is `constrain`)."""
-    m = mesh.shape[MODEL_AXIS]
-    return jax.tree_util.tree_map(
-        lambda leaf: NamedSharding(mesh, leaf_spec(leaf, m)), tree
-    )
-
-
 def constrain(tree: Any, mesh: Mesh):
     """Apply the leaf rule as GSPMD sharding constraints (traceable —
-    call inside jit)."""
+    call inside jit). The jitted train step is the only placement path:
+    initial host states enter replicated and the first constrained step
+    reshards them, so no separate device_put helper is needed."""
     m = mesh.shape[MODEL_AXIS]
     return jax.tree_util.tree_map(
         lambda leaf: jax.lax.with_sharding_constraint(
             leaf, NamedSharding(mesh, leaf_spec(leaf, m))
         ),
         tree,
-    )
-
-
-def shard_params(tree: Any, mesh: Mesh):
-    """Place a host/replicated pytree onto the mesh under the leaf rule
-    (initial placement; ≙ mesh.replicate but model-axis-sharded)."""
-    return jax.tree_util.tree_map(
-        lambda leaf, s: jax.device_put(leaf, s), tree, specs(tree, mesh)
     )
