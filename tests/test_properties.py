@@ -100,18 +100,27 @@ def test_batch_block_is_a_divisor_within_bound(n, want):
     esz=st.sampled_from([2, 4]),
 )
 def test_pick_bb_divides_batch_and_respects_budget(n, rows, cin, cout, taps, esz):
-    """The conv grid invariant: bb divides n; and the modeled scoped
-    footprint of the chosen block stays within the VMEM budget whenever
-    even a single image fits it (bb=1 is the documented floor)."""
+    """The conv grid invariants, r5 contract: bb divides n; the block's
+    sublane dim obeys Mosaic's dtype tile rule (legality BEATS the VMEM
+    target — the documented trade-off behind the sublane-tile fix); and
+    among LEGAL divisors, the budget is respected whenever any legal
+    divisor fits it."""
     w_bytes = taps * cin * cout * 4
     bb = pc._pick_bb(
         n, rows, [cin], [cin] * taps, [cout], esz, esz, w_bytes
     )
     assert 1 <= bb <= n and n % bb == 0
+    tile = 32 // esz
+    assert (bb * rows) % tile == 0 or bb == n
     per_img = rows * (
         esz * (2 * cin + taps * cin) + esz * 2 * cout + 4 * 2 * cout
     )
-    if per_img + 2 * w_bytes <= pc._VMEM_BUDGET:
+    want = max(1, (pc._VMEM_BUDGET - 2 * w_bytes) // max(per_img, 1))
+    legal_within = [
+        d for d in range(1, want + 1)
+        if n % d == 0 and ((d * rows) % tile == 0 or d == n)
+    ]
+    if legal_within:
         assert bb * per_img + 2 * w_bytes <= pc._VMEM_BUDGET
 
 
