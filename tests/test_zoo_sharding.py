@@ -138,6 +138,40 @@ def test_resnet_block_shards_under_model_axis():
     assert any_sharded(st.opt_state), "momentum buffers should shard"
 
 
+def test_model_axis_composes_with_checkpoint_resume(tmp_path):
+    """Kill-and-resume under hybrid DP×model training: sharded params,
+    momentum, and BN stats round-trip through the host-side npz
+    checkpoint (save gathers; the first resumed step reshards) and the
+    resumed trajectory matches the uninterrupted one."""
+    import numpy as np
+
+    imgs, labels = synthetic.make_image_dataset(64, seed=11)
+    model = cifar.cifar_cnn()
+    mesh = mesh_lib.make_mesh(MeshConfig(data=4, model=2))
+    kw = dict(
+        in_shape=cifar.IN_SHAPE, batch_size=32, lr=0.05, seed=3,
+        verbose=False, mesh=mesh, model_axis=True,
+    )
+    continuous, c_losses = zoo.train(model, imgs, labels, epochs=2, **kw)
+
+    ckpt = str(tmp_path / "hyb_ckpts")
+    zoo.train(model, imgs, labels, epochs=1, checkpoint_dir=ckpt, **kw)
+    resumed, r_losses = zoo.train(
+        model, imgs, labels, epochs=2, checkpoint_dir=ckpt, resume=True,
+        **kw,
+    )
+    assert len(r_losses) == 2
+    np.testing.assert_allclose(r_losses, c_losses, rtol=1e-4)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(continuous.params),
+        jax.tree_util.tree_leaves(resumed.params),
+        strict=True,
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4
+        )
+
+
 def test_model_axis_requires_mesh():
     model = cifar.cifar_cnn()
     opt = zoo.make_optimizer()
