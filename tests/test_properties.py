@@ -186,3 +186,58 @@ def test_s2_phase_taps_match_conv_index_equation(k, oy, ox):
             # phase-pixel (u//2, v//2), reading dout at (oy, ox)
             assert iph == (u % 2) * 2 + (v % 2)
             assert (u // 2 + ia, v // 2 + ib) == (oy, ox)
+
+
+@settings(max_examples=5, deadline=None)
+@given(
+    arch=st.lists(
+        st.tuples(
+            st.sampled_from([1, 3, 5]),       # kernel
+            st.sampled_from([1, 1, 2]),       # stride (1 weighted 2:1)
+            st.sampled_from([4, 6, 8]),       # features
+        ),
+        min_size=1, max_size=3,
+    ),
+    seed=st.integers(0, 2**16),
+)
+def test_random_conv_stack_pallas_matches_xla(arch, seed):
+    """Architecture-space differential (r5): a random Conv2D(+ReLU) stack
+    built from nn.layers must produce the same loss and gradients whether
+    its convs run on the hand-written Pallas kernels or XLA — the
+    composed-geometry analog of the per-op CASES in test_pallas_conv."""
+    from parallel_cnn_tpu.nn.core import Sequential
+    from parallel_cnn_tpu.nn.layers import Conv2D, ReLU
+
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((2, 8, 8, 3)).astype(np.float32))
+
+    def build(backend):
+        layers = []
+        for k, s, f in arch:
+            layers += [Conv2D(f, kernel=(k, k), strides=(s, s),
+                              backend=backend), ReLU()]
+        return Sequential(layers)
+
+    outs = {}
+    grads = {}
+    for backend in ("xla", "pallas"):
+        m = build(backend)
+        params, state, _ = m.init(jax.random.key(seed % 97), (8, 8, 3))
+
+        def loss(p):
+            y, _ = m.apply(p, state, x, train=True)
+            return jnp.sum(jnp.sin(y))
+
+        outs[backend], grads[backend] = jax.value_and_grad(loss)(params)
+
+    np.testing.assert_allclose(
+        float(outs["pallas"]), float(outs["xla"]), rtol=1e-5, atol=1e-5
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(grads["pallas"]),
+        jax.tree_util.tree_leaves(grads["xla"]),
+        strict=True,
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4
+        )
