@@ -115,8 +115,8 @@ def main():
         ("b32_accum2_bf16 (microbatch 16, half batch)", 32, 2, jnp.bfloat16),
         ("b32_accum1_bf16 (microbatch 32, half batch)", 32, 1, jnp.bfloat16),
     ]
-    print(f"| row | img/s | MFU | ms/step |")
-    print(f"|---|---|---|---|")
+    print("| row | img/s | MFU | ms/step |")
+    print("|---|---|---|---|")
     for name, b, a, dt in grid:
         try:
             ips, mfu, sec = measure(b, a, dt, args.steps)

@@ -16,7 +16,13 @@ import dataclasses
 import os
 from typing import List, Optional
 
-from parallel_cnn_tpu.config import Config, DataConfig, MeshConfig, TrainConfig
+from parallel_cnn_tpu.config import (
+    Config,
+    DataConfig,
+    MeshConfig,
+    ResilienceConfig,
+    TrainConfig,
+)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -100,6 +106,36 @@ def build_parser() -> argparse.ArgumentParser:
                    help="save ckpt_<epoch>.npz per epoch; --resume restarts "
                         "from the latest")
     p.add_argument("--resume", action="store_true")
+    r = ResilienceConfig()
+    p.add_argument("--sentinel", default=r.policy,
+                   choices=["off", "raise", "skip", "rollback"],
+                   help="health-sentinel policy on a non-finite "
+                        "loss/param: fail fast, discard the epoch, or "
+                        "auto-rollback to the last-good state "
+                        "(resilience/)")
+    p.add_argument("--max-rollbacks", type=int, default=r.max_rollbacks,
+                   help="bounded retry budget for --sentinel rollback")
+    p.add_argument("--lr-backoff", type=float, default=r.lr_backoff,
+                   help="LR multiplier applied per rollback "
+                        "(lenet_ref path; 1.0 keeps the LR)")
+    p.add_argument("--sentinel-every", type=int, default=r.check_every_steps,
+                   metavar="N",
+                   help="zoo models: also run the sentinel every N "
+                        "optimizer steps (0 = epoch boundaries only; "
+                        "each check is a host sync)")
+    p.add_argument("--keep-checkpoints", type=int, default=r.ring_size,
+                   metavar="N",
+                   help="prune --checkpoint-dir to the newest N "
+                        "checkpoints (0 = keep all)")
+    p.add_argument("--no-pallas-fallback", action="store_true",
+                   help="fail instead of degrading to the XLA path when "
+                        "the Pallas kernel path errors")
+    p.add_argument("--chaos", default=None, metavar="SPEC",
+                   help="fault injection for resilience testing: "
+                        "nan@STEP poisons the update at optimizer step "
+                        "STEP; kill@EPOCH / kill9@EPOCH delivers "
+                        "SIGTERM / SIGKILL after epoch EPOCH's "
+                        "checkpoint (resilience/chaos.py)")
     p.add_argument("--metrics", default=None, metavar="PATH",
                    help="append JSONL metrics records to PATH")
     p.add_argument("--profile", action="store_true",
@@ -142,7 +178,16 @@ def config_from_args(args: argparse.Namespace) -> Config:
     # override — no jax import may happen here). A bare `--mesh-model 1`
     # is the single-device default and does not activate the mesh.
     mesh = MeshConfig(data=args.mesh_data, model=args.mesh_model or 1)
-    return Config(data=data, train=train, mesh=mesh, model=args.model)
+    resilience = ResilienceConfig(
+        policy=args.sentinel,
+        max_rollbacks=args.max_rollbacks,
+        lr_backoff=args.lr_backoff,
+        ring_size=args.keep_checkpoints,
+        check_every_steps=args.sentinel_every,
+        pallas_fallback=not args.no_pallas_fallback,
+    )
+    return Config(data=data, train=train, mesh=mesh,
+                  resilience=resilience, model=args.model)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -172,6 +217,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     from parallel_cnn_tpu.data import pipeline
     from parallel_cnn_tpu.models import lenet_ref
     from parallel_cnn_tpu.parallel import distributed
+    from parallel_cnn_tpu.resilience import ChaosMonkey, CheckpointRing
+    from parallel_cnn_tpu.resilience import preempt
     from parallel_cnn_tpu.train import checkpoint, trainer
     from parallel_cnn_tpu.utils.metrics import MetricsLogger, throughput
     from parallel_cnn_tpu.utils import profiling
@@ -181,6 +228,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     if cfg.model != "lenet_ref":
         return _run_zoo(args, cfg)
     train_ds, test_ds = pipeline.load_train_test(cfg.data)
+
+    chaos = ChaosMonkey.from_spec(args.chaos) if args.chaos else None
+    ring = None
+    if args.checkpoint_dir:
+        ring = CheckpointRing(
+            args.checkpoint_dir, keep=cfg.resilience.ring_size
+        )
 
     params = None
     start_epoch = 0
@@ -206,22 +260,38 @@ def main(argv: Optional[List[str]] = None) -> int:
         error_history.append(err)
         if metrics:
             metrics.record(event="epoch", epoch=epoch, error=err)
-        if args.checkpoint_dir:
-            checkpoint.save(
-                os.path.join(args.checkpoint_dir, f"ckpt_{epoch}.npz"),
+        if ring is not None:
+            ring.save(
+                epoch,
                 epoch_params,
                 checkpoint.TrainState(
                     epoch=epoch, epoch_errors=list(error_history)
                 ),
             )
 
-    result = trainer.learn(
-        run_cfg,
-        train_ds,
-        params=params,
-        epoch_offset=start_epoch,
-        epoch_callback=on_epoch,
-    )
+    # SIGTERM/SIGINT stop training at the next epoch boundary with the
+    # checkpoint already flushed (resilience/preempt) — the cloud
+    # preemption contract the reference lacks.
+    with preempt.PreemptionGuard() as guard:
+        result = trainer.learn(
+            run_cfg,
+            train_ds,
+            params=params,
+            epoch_offset=start_epoch,
+            epoch_callback=on_epoch,
+            chaos=chaos,
+            ring=ring,
+        )
+
+    if result.preempted or guard.preempted:
+        if metrics:
+            metrics.record(
+                event="preempted",
+                epoch=start_epoch + len(result.epoch_errors),
+            )
+            metrics.close()
+        print("preempted: checkpoint flushed; continue with --resume")
+        return 0
 
     rate = trainer.test(result.params, test_ds)
     if metrics:
@@ -257,6 +327,8 @@ def _run_zoo(args: argparse.Namespace, cfg: Config) -> int:
     from parallel_cnn_tpu.data import synthetic
     from parallel_cnn_tpu.nn import cifar, resnet, vgg
     from parallel_cnn_tpu.parallel import mesh as mesh_lib
+    from parallel_cnn_tpu.resilience import ChaosMonkey
+    from parallel_cnn_tpu.resilience import preempt
     from parallel_cnn_tpu.train import zoo
     from parallel_cnn_tpu.utils.metrics import MetricsLogger
 
@@ -307,38 +379,44 @@ def _run_zoo(args: argparse.Namespace, cfg: Config) -> int:
         raise SystemExit("zoo models train minibatch; use --batch-size > 1")
     else:
         batch = args.batch_size
-    zoo.train(
-        model,
-        imgs,
-        labels,
-        in_shape=cifar.IN_SHAPE,
-        epochs=args.epochs,
-        batch_size=batch,
-        lr=args.lr,
-        lr_schedule=args.lr_schedule,
-        warmup_steps=args.warmup_steps,
-        augment=args.augment,
-        accum_steps=args.accum_steps,
-        mesh=mesh,
-        model_axis=model_axis,
-        seed=args.seed,
-        eval_data=(ev_imgs, ev_labels),
-        checkpoint_dir=args.checkpoint_dir,
-        resume=args.resume,
-        metrics=metrics,
-        loader=args.zoo_loader,
-        # Zoo --profile = a jax.profiler trace of 3 steady-state steps of
-        # THE run's own jitted step (augment/schedule/accum/mesh included;
-        # compile excluded) — the single-chip MFU attribution tool. The
-        # lenet path's --profile prints the per-phase table instead.
-        profile_trace_dir=(
-            os.path.abspath(
-                os.path.join(args.checkpoint_dir or ".", "zoo_xla_trace")
-            )
-            if args.profile
-            else None
-        ),
-    )
+    chaos = ChaosMonkey.from_spec(args.chaos) if args.chaos else None
+    with preempt.PreemptionGuard() as guard:
+        zoo.train(
+            model,
+            imgs,
+            labels,
+            in_shape=cifar.IN_SHAPE,
+            epochs=args.epochs,
+            batch_size=batch,
+            lr=args.lr,
+            lr_schedule=args.lr_schedule,
+            warmup_steps=args.warmup_steps,
+            augment=args.augment,
+            accum_steps=args.accum_steps,
+            mesh=mesh,
+            model_axis=model_axis,
+            seed=args.seed,
+            eval_data=(ev_imgs, ev_labels),
+            checkpoint_dir=args.checkpoint_dir,
+            resume=args.resume,
+            metrics=metrics,
+            loader=args.zoo_loader,
+            resilience=cfg.resilience,
+            chaos=chaos,
+            # Zoo --profile = a jax.profiler trace of 3 steady-state steps
+            # of THE run's own jitted step (augment/schedule/accum/mesh
+            # included; compile excluded) — the single-chip MFU attribution
+            # tool. The lenet path's --profile prints the per-phase table.
+            profile_trace_dir=(
+                os.path.abspath(
+                    os.path.join(args.checkpoint_dir or ".", "zoo_xla_trace")
+                )
+                if args.profile
+                else None
+            ),
+        )
+    if guard.preempted:
+        print("preempted: checkpoint flushed; continue with --resume")
     if metrics:
         metrics.close()
     return 0

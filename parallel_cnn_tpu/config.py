@@ -97,6 +97,46 @@ class TrainConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ResilienceConfig:
+    """Fault-tolerance policy (resilience/ subsystem — a capability class
+    the reference lacks entirely: a NaN loss compares false against the
+    stop threshold and trains a dead model forever, SURVEY.md §5)."""
+
+    # What the health sentinel does on a non-finite loss/grad/param:
+    #   "off"      — no checks (the reference's behavior);
+    #   "raise"    — fail fast with resilience.DivergenceError;
+    #   "skip"     — discard the poisoned update, continue from last-good;
+    #   "rollback" — restore the newest healthy state and retry, LR scaled
+    #                by lr_backoff per retry, at most max_rollbacks times.
+    policy: str = "raise"
+    max_rollbacks: int = 3
+    # LR multiplier applied per rollback (1.0 = keep the LR).
+    lr_backoff: float = 0.5
+    # Checkpoint ring size: keep the newest N on-disk checkpoints
+    # (0 = unbounded, the historical per-epoch behavior).
+    ring_size: int = 0
+    # Zoo trainer: also check loss/param finiteness every N optimizer
+    # steps (0 = epoch boundaries only). Each check is a host sync, so
+    # per-step checking trades dispatch asynchrony for detection latency.
+    check_every_steps: int = 0
+    # Compile-failure degrade: when the Pallas kernel path fails, log one
+    # warning and complete the run on the XLA reference path.
+    pallas_fallback: bool = True
+
+    def __post_init__(self):
+        if self.policy not in ("off", "raise", "skip", "rollback"):
+            raise ValueError(f"unknown sentinel policy {self.policy!r}")
+        if self.max_rollbacks < 0:
+            raise ValueError("max_rollbacks must be >= 0")
+        if not 0.0 < self.lr_backoff <= 1.0:
+            raise ValueError(
+                f"lr_backoff must be in (0, 1], got {self.lr_backoff}"
+            )
+        if self.ring_size < 0 or self.check_every_steps < 0:
+            raise ValueError("ring_size/check_every_steps must be >= 0")
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh layout (the TPU-native replacement for `mpirun -np N` +
     per-kernel MPI_Reduce, MPI/Main.cpp:44 / MPI/layer.h). Axis names are
@@ -112,6 +152,9 @@ class Config:
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    resilience: ResilienceConfig = dataclasses.field(
+        default_factory=ResilienceConfig
+    )
     model: str = "lenet_ref"
 
     def replace(self, **kw) -> "Config":

@@ -28,6 +28,12 @@ import numpy as np
 
 from parallel_cnn_tpu.data.mnist import MnistError
 
+# Chaos/ops escape hatch: force the no-native fallback path without
+# touching the filesystem (resilience/chaos.py hidden_native_lib uses it
+# to prove pipeline.py's NumPy degradation deterministically).
+if os.environ.get("PCNN_DISABLE_NATIVE") == "1":
+    raise ImportError("native runtime disabled via PCNN_DISABLE_NATIVE=1")
+
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir, "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libpcnn_native.so")
 
@@ -98,7 +104,24 @@ def _load_lib() -> ctypes.CDLL:
     return lib
 
 
-_lib = _load_lib()
+def _load_lib_with_retry() -> ctypes.CDLL:
+    """dlopen can fail transiently on shared filesystems (a sibling process
+    mid-`os.replace` of the .so, NFS attribute-cache lag): retry briefly
+    before degrading to the NumPy fallback. ImportError (no toolchain) is
+    permanent and not retried."""
+    from parallel_cnn_tpu.resilience.retry import RetryPolicy, retry_call
+
+    policy = RetryPolicy(
+        attempts=int(os.environ.get("PCNN_NATIVE_RETRIES", "2")),
+        base_delay=0.1,
+        max_delay=1.0,
+    )
+    return retry_call(
+        _load_lib, policy=policy, retry_on=(OSError,), describe="native dlopen"
+    )
+
+
+_lib = _load_lib_with_retry()
 
 _ERROR_MESSAGES = {
     -1: "no such file",

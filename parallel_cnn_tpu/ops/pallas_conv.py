@@ -65,6 +65,7 @@ default).
 from __future__ import annotations
 
 import functools
+import logging
 from typing import List, Sequence, Tuple
 
 import jax
@@ -77,6 +78,8 @@ from jax.experimental.pallas import tpu as pltpu
 # Shared with the LeNet kernel library: compile-vs-interpret keys off the
 # axon-aware TPU detection, and batch blocks must divide the batch.
 from parallel_cnn_tpu.ops.pallas import _batch_block, _interpret  # noqa: E402
+
+log = logging.getLogger(__name__)
 
 
 # Scoped-VMEM model for choosing how many images ride one grid step.
@@ -297,7 +300,27 @@ def _pick_bb(
         if n % d == 0 and ((d * rows) % tile == 0 or d == n)
     ]
     below = [d for d in legal if d <= want]
-    return max(below) if below else min(legal)
+    if below:
+        return max(below)
+    # No legal divisor fits the budget — the tiling constraint forces a
+    # bigger block. Surface how far over the model says we land: over
+    # budget is fine (the limit leaves headroom) but worth a debug trace;
+    # over the hard limit predicts a Mosaic scoped-VMEM OOM.
+    bb = min(legal)
+    modeled = bb * per_img + 2 * w_bytes
+    if modeled > _VMEM_LIMIT:
+        log.warning(
+            "pallas conv block bb=%d models %.1fMB VMEM, over the %.0fMB "
+            "limit — expect a Mosaic OOM at this shape",
+            bb, modeled / 2**20, _VMEM_LIMIT / 2**20,
+        )
+    elif modeled > _VMEM_BUDGET:
+        log.debug(
+            "pallas conv block bb=%d models %.1fMB VMEM, over the %.0fMB "
+            "budget (tiling forced a larger-than-wanted block)",
+            bb, modeled / 2**20, _VMEM_BUDGET / 2**20,
+        )
+    return bb
 
 
 def _compiler_params():
@@ -340,10 +363,15 @@ def _tapped_matmul(
         (sum(1 for e in plan if e[0] == "p") for plan in plan_per_out),
         default=0,
     )
+    # Both weight stacks ride the grid double-buffered: the paired
+    # (wp_stack) bytes count against VMEM exactly like the singles.
+    w_bytes = w_stack.size * w_stack.dtype.itemsize
+    if have_pairs:
+        w_bytes += wp_stack.size * wp_stack.dtype.itemsize
     bb = _pick_bb(
         n, rows_per_img, cins, tap_cins, couts, esz,
         jnp.dtype(out_dtype).itemsize,
-        w_stack.size * w_stack.dtype.itemsize,
+        w_bytes,
         pair_temps=max_pairs,
     )
     w_inputs = [w_stack] + ([wp_stack] if have_pairs else [])
@@ -719,8 +747,8 @@ def _conv2d_bwd(stride, res, g):
         gw = _wgrad_s2_even(x, g, k)
         return dx.astype(x.dtype), gw.astype(w.dtype)
     if stride == 2:
-        # Odd-dim k=3 fallback: scatter dout onto the stride-1 grid at
-        # the forward's phase, then stride-1 grads.
+        # Odd-dim fallback (k-generic): scatter dout onto the stride-1
+        # grid at the forward's phase, then stride-1 grads.
         oy, ox = _s2_offsets(h, wd, k)
         gfull = jnp.zeros((b, h, wd, cout), g.dtype)
         g = gfull.at[:, oy::2, ox::2, :].set(g)

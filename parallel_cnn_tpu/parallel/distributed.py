@@ -38,8 +38,12 @@ def initialize(
 
     Explicit args win; else PCNN_* env vars; else, when `auto` (or
     PCNN_AUTO_DISTRIBUTED=1), TPU-pod auto-detection via a bare
-    jax.distributed.initialize(). With none of those, single-process no-op
-    — genuine bring-up failures propagate (fail fast like MPI_Init).
+    jax.distributed.initialize(). With none of those, single-process no-op.
+
+    Bring-up rides mesh.distributed_init's jittered-backoff retry
+    (PCNN_INIT_RETRIES attempts — coordinator races are the common
+    transient); once that budget is spent, failures propagate (fail fast
+    like MPI_Init).
     """
     coordinator_address = coordinator_address or os.environ.get(
         "PCNN_COORDINATOR"

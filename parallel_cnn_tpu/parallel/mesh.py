@@ -16,6 +16,7 @@ exist by construction.
 from __future__ import annotations
 
 import math
+import os
 from typing import Optional, Sequence
 
 import jax
@@ -96,7 +97,8 @@ def pad_to_multiple(n: int, k: int) -> int:
 
 
 def distributed_init(coordinator: Optional[str] = None, num_processes: Optional[int] = None,
-                     process_id: Optional[int] = None) -> None:
+                     process_id: Optional[int] = None,
+                     retry: Optional["object"] = None) -> None:
     """Multi-host bring-up (≙ MPI_Init, MPI/Main.cpp:44).
 
     On a TPU pod slice all arguments are auto-detected from the environment;
@@ -104,9 +106,32 @@ def distributed_init(coordinator: Optional[str] = None, num_processes: Optional[
     initialized (unlike MPI_Init). The reference's MPI_Finalize is dead code
     after `return` (bug B8); JAX needs no finalize at all.
 
-    Genuine bring-up failures (bad coordinator, barrier timeout) propagate —
-    failing fast like MPI_Init, not silently degrading to single-process.
+    Transient bring-up failures — the coordinator not yet listening,
+    barrier timeouts while other hosts boot — are retried with jittered
+    exponential backoff (``retry`` is a resilience.RetryPolicy; default
+    PCNN_INIT_RETRIES attempts, 3). Once the budget is exhausted the last
+    error propagates — still failing fast like MPI_Init, just not on the
+    very first race with the coordinator.
     """
     if jax.distributed.is_initialized():
         return  # already initialized — idempotent by design
-    jax.distributed.initialize(coordinator, num_processes, process_id)
+
+    from parallel_cnn_tpu.resilience.retry import RetryPolicy, retry_call
+
+    if retry is None:
+        retry = RetryPolicy(
+            attempts=int(os.environ.get("PCNN_INIT_RETRIES", "3")),
+            base_delay=0.5,
+        )
+    retry_call(
+        jax.distributed.initialize,
+        coordinator,
+        num_processes,
+        process_id,
+        policy=retry,
+        # The realistic transient failures surface as these; anything else
+        # (bad arguments, TypeError) is a programming error and propagates
+        # on the first attempt.
+        retry_on=(RuntimeError, ConnectionError, OSError, TimeoutError),
+        describe="jax.distributed.initialize",
+    )

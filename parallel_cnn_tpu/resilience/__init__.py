@@ -1,0 +1,40 @@
+"""Fault-tolerant training runtime (SURVEY.md §5's reliability gap).
+
+The reference is one-shot and fragile: a NaN trains forever on a dead
+model, a kill loses the run, a flaky substrate call is fatal. This
+package makes failure a handled event across five axes:
+
+- ``sentinel``  — jitted loss/grad/param finiteness checks with a
+                  configured policy (raise / skip / rollback);
+- ``rollback``  — last-good checkpoint ring + bounded auto-rollback with
+                  optional LR backoff;
+- ``preempt``   — SIGTERM/SIGINT → flush a final atomic checkpoint and
+                  stop at the next epoch boundary (pairs with --resume);
+- ``retry``     — deterministic jittered exponential backoff and the
+                  one-warning permanent Pallas→XLA fallback;
+- ``chaos``     — the fault-injection harness that proves every one of
+                  the recovery paths end-to-end (tests/test_resilience.py).
+
+Policy knobs live in config.ResilienceConfig; the CLI exposes them as
+--sentinel / --max-rollbacks / --lr-backoff / --sentinel-every /
+--keep-checkpoints / --chaos.
+"""
+
+from parallel_cnn_tpu.resilience.chaos import ChaosMonkey  # noqa: F401
+from parallel_cnn_tpu.resilience.preempt import PreemptionGuard  # noqa: F401
+from parallel_cnn_tpu.resilience.retry import (  # noqa: F401
+    RetryPolicy,
+    retry_call,
+    with_fallback,
+)
+from parallel_cnn_tpu.resilience.rollback import (  # noqa: F401
+    CheckpointRing,
+    RollbackController,
+)
+from parallel_cnn_tpu.resilience.sentinel import (  # noqa: F401
+    DivergenceError,
+    RetriesExhaustedError,
+    Sentinel,
+    Verdict,
+    tree_all_finite,
+)

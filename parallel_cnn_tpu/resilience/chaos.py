@@ -1,0 +1,159 @@
+"""Deterministic chaos / fault-injection harness.
+
+Every recovery path in this package is *proven*, not assumed: the chaos
+harness injects the failure on a fixed, seeded schedule and
+tests/test_resilience.py drives training through it end-to-end. Faults:
+
+- **NaN at step k** (``ChaosMonkey(nan_step=k)``): after the k-th
+  optimizer step (host-side, 0-based, counted across epochs), the
+  inexact leaves of the returned state are replaced with NaN — exactly
+  the state a NaN gradient produces (``p += dt * NaN == NaN``), injected
+  at the same host boundary the sentinel polls. One-shot: the retried
+  epoch after a rollback is NOT re-poisoned, so bounded recovery can be
+  asserted deterministically.
+- **Kill at an epoch boundary** (``kill_epoch=e``): after epoch ``e``'s
+  checkpoint callback ran, deliver a real signal to this process —
+  SIGTERM exercises the graceful preempt path, SIGKILL the torn-process
+  + ``--resume`` path (subprocess tests only, naturally).
+- **Checkpoint corruption** (``truncate_file`` / ``corrupt_file``):
+  deterministic byte-level damage, for proving restore() fails loudly
+  and the CheckpointRing falls through to the previous healthy file.
+- **Native library loss** (``hidden_native_lib``): makes
+  ``parallel_cnn_tpu.data.native`` raise ImportError (via the
+  PCNN_DISABLE_NATIVE hook that module checks before touching the
+  toolchain), proving the NumPy fallbacks engage.
+
+No wall clocks, no unseeded randomness — a chaos run replays exactly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import signal
+import sys
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def poison_tree(tree: Any) -> Any:
+    """NaN every inexact leaf (ints/bools — e.g. optimizer step counters —
+    stay intact, as a real NaN gradient would leave them)."""
+    return jax.tree_util.tree_map(
+        lambda a: (
+            jnp.full_like(a, jnp.nan)
+            if jnp.issubdtype(jnp.asarray(a).dtype, jnp.inexact)
+            else a
+        ),
+        tree,
+    )
+
+
+class ChaosMonkey:
+    """One-shot fault injector threaded through the epoch drivers.
+
+    The trainers call ``after_step`` once per optimizer step (the
+    strict-parity scan counts as one step — the whole epoch is one
+    program) and ``at_epoch`` once per completed epoch, after the
+    checkpoint callback.
+    """
+
+    def __init__(
+        self,
+        nan_step: Optional[int] = None,
+        kill_epoch: Optional[int] = None,
+        kill_signal: int = signal.SIGTERM,
+    ):
+        self.nan_step = nan_step
+        self.kill_epoch = kill_epoch
+        self.kill_signal = kill_signal
+        self.steps_seen = 0
+        self.nan_fired = False
+        self.kill_fired = False
+
+    def after_step(self, tree: Any, loss: Any) -> Tuple[Any, Any]:
+        """Post-step hook: returns (possibly poisoned) (tree, loss)."""
+        step = self.steps_seen
+        self.steps_seen += 1
+        if (
+            self.nan_step is not None
+            and step == self.nan_step
+            and not self.nan_fired
+        ):
+            self.nan_fired = True
+            return poison_tree(tree), loss
+        return tree, loss
+
+    def at_epoch(self, epoch: int) -> None:
+        """Epoch-boundary hook: deliver the configured kill signal."""
+        if (
+            self.kill_epoch is not None
+            and epoch >= self.kill_epoch
+            and not self.kill_fired
+        ):
+            self.kill_fired = True
+            os.kill(os.getpid(), self.kill_signal)
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "ChaosMonkey":
+        """Parse a CLI fault spec: ``nan@STEP``, ``kill@EPOCH`` (SIGTERM),
+        or ``kill9@EPOCH`` (SIGKILL)."""
+        kind, sep, arg = spec.partition("@")
+        if not sep or not arg.isdigit():
+            raise ValueError(
+                f"bad chaos spec {spec!r}; expected nan@STEP, kill@EPOCH "
+                "or kill9@EPOCH"
+            )
+        n = int(arg)
+        if kind == "nan":
+            return cls(nan_step=n)
+        if kind == "kill":
+            return cls(kill_epoch=n, kill_signal=signal.SIGTERM)
+        if kind == "kill9":
+            return cls(kill_epoch=n, kill_signal=signal.SIGKILL)
+        raise ValueError(f"unknown chaos fault {kind!r} in {spec!r}")
+
+
+def truncate_file(path: str, keep_bytes: int = 16) -> None:
+    """Truncate a file to its first ``keep_bytes`` bytes (a torn write)."""
+    with open(path, "r+b") as f:
+        f.truncate(keep_bytes)
+
+
+def corrupt_file(path: str, *, seed: int = 0, n_bytes: int = 64) -> None:
+    """Deterministically overwrite ``n_bytes`` in the middle of a file
+    (bit-rot / partial overwrite, size preserved)."""
+    size = os.path.getsize(path)
+    start = max(0, size // 2 - n_bytes // 2)
+    import random
+
+    junk = bytes(random.Random(seed).randrange(256) for _ in range(n_bytes))
+    with open(path, "r+b") as f:
+        f.seek(start)
+        f.write(junk[: max(0, size - start)])
+
+
+@contextlib.contextmanager
+def hidden_native_lib():
+    """Make the native C++ runtime unimportable for the duration.
+
+    Sets PCNN_DISABLE_NATIVE=1 (data/native.py raises ImportError before
+    touching the toolchain) and evicts any cached module, so the NumPy
+    fallback paths are exercised; restores both on exit.
+    """
+    modname = "parallel_cnn_tpu.data.native"
+    saved_module = sys.modules.pop(modname, None)
+    saved_env = os.environ.get("PCNN_DISABLE_NATIVE")
+    os.environ["PCNN_DISABLE_NATIVE"] = "1"
+    try:
+        yield
+    finally:
+        if saved_env is None:
+            os.environ.pop("PCNN_DISABLE_NATIVE", None)
+        else:
+            os.environ["PCNN_DISABLE_NATIVE"] = saved_env
+        sys.modules.pop(modname, None)
+        if saved_module is not None:
+            sys.modules[modname] = saved_module

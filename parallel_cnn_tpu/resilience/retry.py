@@ -1,0 +1,128 @@
+"""Deterministic retry/backoff + permanent-fallback wrappers.
+
+The reference treats every substrate call as infallible: `MPI_Init` either
+works or the job dies (MPI/Main.cpp:44), a failed data read returns an
+error code that main() ignores. Real long-running jobs see transient
+failures — a coordinator that isn't up yet, an NFS blip during a native
+build, a kernel that compiles on one toolchain and not another. This
+module gives those call sites two disciplined shapes:
+
+- ``retry_call`` — bounded, capped exponential backoff with *seeded*
+  jitter: the delay sequence is a pure function of the policy, so tests
+  (and post-mortems) can replay it exactly. No infinite retry loops by
+  construction — attempts is a hard bound.
+- ``with_fallback`` — wrap a primary callable so the first failure flips
+  it permanently to a secondary implementation, logging exactly one
+  warning (the Pallas→XLA kernel-path degrade in train/step.py).
+
+Pure stdlib on purpose: imported by data/native.py and parallel/mesh.py
+before/without JAX.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import time
+from typing import Callable, Iterator, Tuple, Type
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Capped exponential backoff with deterministic (seeded) jitter.
+
+    The k-th delay is ``min(base_delay * multiplier**k, max_delay)``
+    scaled by a jitter factor drawn uniformly from
+    ``[1 - jitter, 1 + jitter]`` using ``random.Random(seed)`` — the same
+    policy always produces the same delay sequence.
+    """
+
+    attempts: int = 3
+    base_delay: float = 0.5
+    max_delay: float = 30.0
+    multiplier: float = 2.0
+    jitter: float = 0.5
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.attempts < 1:
+            raise ValueError(f"attempts must be >= 1, got {self.attempts}")
+        if self.base_delay < 0 or self.max_delay < 0:
+            raise ValueError("delays must be non-negative")
+        if not 0.0 <= self.jitter < 1.0:
+            raise ValueError(f"jitter must be in [0, 1), got {self.jitter}")
+        if self.multiplier < 1.0:
+            raise ValueError("multiplier must be >= 1")
+
+    def delays(self) -> Iterator[float]:
+        """The (attempts - 1) sleep durations between attempts."""
+        rng = random.Random(self.seed)
+        for k in range(self.attempts - 1):
+            d = min(self.base_delay * self.multiplier**k, self.max_delay)
+            yield d * (1.0 + self.jitter * (2.0 * rng.random() - 1.0))
+
+
+def retry_call(
+    fn: Callable,
+    *args,
+    policy: RetryPolicy | None = None,
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+    sleep: Callable[[float], None] = time.sleep,
+    describe: str | None = None,
+    **kwargs,
+):
+    """Call ``fn(*args, **kwargs)``, retrying ``retry_on`` failures.
+
+    Bounded by ``policy.attempts``; the final failure propagates
+    unchanged. Pass ``sleep`` to intercept the backoff in tests.
+    """
+    policy = policy or RetryPolicy()
+    delays = list(policy.delays())
+    name = describe or getattr(fn, "__name__", repr(fn))
+    for attempt in range(policy.attempts):
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as e:
+            if attempt == policy.attempts - 1:
+                raise
+            d = delays[attempt]
+            log.warning(
+                "%s failed (attempt %d/%d, %s: %s); retrying in %.2fs",
+                name, attempt + 1, policy.attempts, type(e).__name__, e, d,
+            )
+            sleep(d)
+
+
+def with_fallback(
+    primary: Callable,
+    secondary: Callable,
+    *,
+    name: str = "primary",
+    on: Tuple[Type[BaseException], ...] = (Exception,),
+) -> Callable:
+    """Wrap ``primary`` so its first failure permanently switches every
+    subsequent call to ``secondary``, logging exactly one warning.
+
+    Unlike retry_call this never re-tries the primary: a failed kernel
+    compile fails identically on every call, so the switch is one-way and
+    the run completes on the fallback path.
+    """
+    state = {"fallen_back": False}
+
+    def wrapped(*args, **kwargs):
+        if not state["fallen_back"]:
+            try:
+                return primary(*args, **kwargs)
+            except on as e:
+                state["fallen_back"] = True
+                log.warning(
+                    "%s failed (%s: %s); falling back permanently",
+                    name, type(e).__name__, e,
+                )
+        return secondary(*args, **kwargs)
+
+    wrapped.fallback_engaged = lambda: state["fallen_back"]
+    return wrapped

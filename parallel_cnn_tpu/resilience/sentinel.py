@@ -1,0 +1,85 @@
+"""Health sentinel: jitted finiteness checks over loss / grads / params.
+
+The reference has no divergence story at all: a NaN loss sails straight
+through the `err < threshold` comparison (NaN compares false, so the loop
+just keeps training a dead model — SURVEY.md §5). The sentinel makes
+non-finiteness a *detected event* with a configured response
+(config.ResilienceConfig.policy):
+
+- ``"raise"``    — fail fast with DivergenceError (the default);
+- ``"skip"``     — discard the poisoned update, keep the last-good state,
+                   move on;
+- ``"rollback"`` — restore the newest healthy state (resilience/rollback)
+                   with an optional LR backoff and a bounded retry count.
+
+The tree check is one jitted all-finite reduce (per epoch in the parity
+trainer, every-N-steps in the zoo trainer when
+``check_every_steps > 0``), so the cost is a single scalar readback at a
+boundary where the driver already synchronizes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class DivergenceError(RuntimeError):
+    """Training produced a non-finite loss/grad/param and policy='raise'."""
+
+
+class RetriesExhaustedError(RuntimeError):
+    """Auto-rollback gave up: the divergence recurred past max_rollbacks."""
+
+
+@jax.jit
+def tree_all_finite(tree: Any) -> jax.Array:
+    """Scalar bool: every inexact leaf of ``tree`` is finite.
+
+    Integer/bool leaves (e.g. optimizer step counters) are finite by
+    construction and skipped at trace time.
+    """
+    checks = [
+        jnp.all(jnp.isfinite(leaf))
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact)
+    ]
+    if not checks:
+        return jnp.bool_(True)
+    return jnp.stack(checks).all()
+
+
+@dataclasses.dataclass(frozen=True)
+class Verdict:
+    healthy: bool
+    reason: str = ""
+
+    def __bool__(self) -> bool:
+        return self.healthy
+
+
+class Sentinel:
+    """Stateless health checker; the trainers own the policy response.
+
+    Check order is cheapest-first: the loss is a host float the epoch
+    loop already materialized, so a NaN loss costs nothing extra to
+    catch; the tree reduces only run when the loss looked fine.
+    """
+
+    def check(
+        self,
+        *,
+        loss: Optional[float] = None,
+        grads: Any = None,
+        params: Any = None,
+    ) -> Verdict:
+        if loss is not None and not math.isfinite(float(loss)):
+            return Verdict(False, f"non-finite loss ({float(loss)})")
+        for name, tree in (("grads", grads), ("params", params)):
+            if tree is not None and not bool(tree_all_finite(tree)):
+                return Verdict(False, f"non-finite {name}")
+        return Verdict(True)

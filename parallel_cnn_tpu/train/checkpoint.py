@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import os
 import tempfile
+import zipfile
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -79,14 +80,26 @@ def restore(path: str, like) -> Tuple[Any, TrainState]:
     Validates that the stored keys/shapes/dtypes exactly match `like` —
     a renamed layer or changed shape is a hard error, not a silent
     partial load.
+
+    A torn or bit-flipped file (truncation, corrupted zip member, missing
+    or unparseable metadata) raises ValueError — one typed failure mode the
+    callers (CheckpointRing.restore_latest, CLI --resume) can catch to skip
+    to an older checkpoint instead of crashing on whatever numpy/zipfile
+    internals the damage happened to hit.
     """
-    with np.load(path) as z:
-        meta = json.loads(bytes(z["__meta__"]).decode())
-        if meta.get("version") != FORMAT_VERSION:
-            raise ValueError(
-                f"checkpoint version {meta.get('version')} != {FORMAT_VERSION}"
-            )
-        stored = {k: z[k] for k in z.files if k != "__meta__"}
+    try:
+        with np.load(path) as z:
+            meta = json.loads(bytes(z["__meta__"]).decode())
+            stored = {k: z[k] for k in z.files if k != "__meta__"}
+    except (zipfile.BadZipFile, EOFError, OSError, KeyError,
+            json.JSONDecodeError) as e:
+        raise ValueError(
+            f"corrupted or unreadable checkpoint {path!r}: {e}"
+        ) from e
+    if meta.get("version") != FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint version {meta.get('version')} != {FORMAT_VERSION}"
+        )
 
     want = _flatten(like)
     if set(stored) != set(want):
@@ -125,6 +138,8 @@ def latest(directory: str, prefix: str = "ckpt_") -> Optional[str]:
         return None
     best, best_epoch = None, -1
     for name in os.listdir(directory):
+        if name.endswith(".tmp.npz"):
+            continue  # torn in-flight write (save() died pre-rename)
         if name.startswith(prefix) and name.endswith(".npz"):
             try:
                 epoch = int(name[len(prefix):-4])

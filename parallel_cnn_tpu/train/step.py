@@ -121,9 +121,26 @@ def pallas_batched_step(
     return apply_grad(params, mean_grads, dt), err.astype(jnp.float32)
 
 
-def batched_step_fn(ops_path: str):
-    """The minibatch step for a TrainConfig.ops value."""
-    return pallas_batched_step if ops_path == "pallas" else batched_step
+def batched_step_fn(ops_path: str, fallback: bool = False):
+    """The minibatch step for a TrainConfig.ops value.
+
+    ``fallback=True`` (cfg.resilience.pallas_fallback, trainer-driven
+    runs) wraps the Pallas step so a kernel-path failure — typically a
+    Mosaic compile error on a toolchain the kernels don't support — logs
+    a single warning and permanently degrades to the XLA reference step;
+    the run completes instead of dying. Direct callers (the differential
+    kernel tests) keep the strict default: a Pallas failure is a Pallas
+    failure.
+    """
+    if ops_path != "pallas":
+        return batched_step
+    if not fallback:
+        return pallas_batched_step
+    from parallel_cnn_tpu.resilience.retry import with_fallback
+
+    return with_fallback(
+        pallas_batched_step, batched_step, name="pallas batched step"
+    )
 
 
 @jax.jit

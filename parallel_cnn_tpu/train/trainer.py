@@ -20,6 +20,13 @@ from parallel_cnn_tpu.config import Config
 from parallel_cnn_tpu.data import pipeline
 from parallel_cnn_tpu.models import lenet_ref
 from parallel_cnn_tpu.parallel import data_parallel, intra_op, mesh as mesh_lib
+from parallel_cnn_tpu.resilience import preempt
+from parallel_cnn_tpu.resilience.retry import with_fallback
+from parallel_cnn_tpu.resilience.rollback import (
+    RollbackController,
+    tree_copy,
+)
+from parallel_cnn_tpu.resilience.sentinel import DivergenceError, Sentinel
 from parallel_cnn_tpu.train import step as step_lib
 from parallel_cnn_tpu.utils.timing import Stopwatch
 
@@ -32,6 +39,11 @@ class TrainResult:
     epoch_errors: List[float] = field(default_factory=list)
     seconds: float = 0.0
     stopped_early: bool = False
+    # Fault-tolerance outcomes (resilience/): how many divergences were
+    # rolled back, and whether a preemption signal stopped the run early
+    # (the last finished epoch is checkpointed; --resume continues it).
+    rollbacks: int = 0
+    preempted: bool = False
 
 
 def _native_batcher_cls(tc):
@@ -111,6 +123,8 @@ def learn(
     verbose: bool = True,
     epoch_offset: int = 0,
     epoch_callback=None,
+    chaos=None,
+    ring=None,
 ) -> TrainResult:
     """≙ learn() (Sequential/Main.cpp:146-184): epoch loop with mean
     err-norm metric and threshold early-stop.
@@ -123,8 +137,21 @@ def learn(
     of epochs already completed). `epoch_callback(epoch, params, err)` —
     with `epoch` global (offset included, 1-based) — fires after every
     epoch; use it for mid-training checkpoints and metrics.
+
+    Fault tolerance (cfg.resilience): each epoch's loss and params pass
+    the health sentinel; a non-finite result triggers the configured
+    policy (raise / skip / rollback with LR backoff, bounded by
+    max_rollbacks). A rollback restores the in-memory last-good snapshot
+    (or `ring`, a resilience.CheckpointRing, across processes) and
+    retries the SAME epoch — the per-epoch derived seed makes the retry
+    deterministic. A preemption signal (resilience/preempt) stops the
+    loop at the next epoch boundary, after `epoch_callback` has flushed
+    its checkpoint. `chaos` is a resilience.ChaosMonkey used by the fault
+    -injection tests; it is consulted after every optimizer step (the
+    strict-parity scan counts as one) and at every epoch boundary.
     """
     tc = cfg.train
+    res = cfg.resilience
     if params is None:
         params = lenet_ref.init(jax.random.key(tc.seed))
     else:
@@ -143,14 +170,34 @@ def learn(
     batcher_cls = _native_batcher_cls(tc)
     steps_per_epoch = len(train) // tc.batch_size if tc.batch_size > 1 else 0
     # Which kernel library executes the minibatch step (cfg.train.ops):
-    # path A (jnp/lax) or path B (Pallas/Mosaic).
-    batched_step = step_lib.batched_step_fn(tc.ops)
+    # path A (jnp/lax) or path B (Pallas/Mosaic). With pallas_fallback a
+    # kernel-path failure (e.g. Mosaic compile error on an unsupported
+    # toolchain) logs one warning and completes the run on path A.
+    batched_step = step_lib.batched_step_fn(
+        tc.ops, fallback=res.pallas_fallback
+    )
+
+    # dt is a local because auto-rollback may scale it (res.lr_backoff);
+    # the jitted steps take it as a static arg, so a changed dt is just
+    # one extra compile on the (rare) recovery path.
+    dt = tc.dt
+
+    sentinel = Sentinel() if res.policy != "off" else None
+    controller = None
+    if res.policy == "rollback":
+        controller = RollbackController(
+            max_rollbacks=res.max_rollbacks,
+            lr_backoff=res.lr_backoff,
+            ring=ring,
+        )
+    last_good = None
 
     # Mesh routing (cfg.mesh, opt-in): DP when model axis is 1, hybrid
     # DP×intra-op otherwise. Params move into their mesh layout once; each
     # batch is shard-put over the data axis.
     mesh = _maybe_mesh(cfg)
     mesh_step = None
+    build_mesh_step = None
     if mesh is not None:
         if steps_per_epoch == 0:
             raise ValueError(
@@ -158,20 +205,47 @@ def learn(
             )
         if mesh.shape[mesh_lib.MODEL_AXIS] > 1:
             params = intra_op.shard_params(mesh, params)
-            mesh_step = intra_op.make_2d_step(
-                mesh, dt=tc.dt, global_batch=tc.batch_size,
-                compute_dtype=tc.dtype,
-            )
+
+            def build_mesh_step(dt_):
+                return intra_op.make_2d_step(
+                    mesh, dt=dt_, global_batch=tc.batch_size,
+                    compute_dtype=tc.dtype,
+                )
         else:
             params = mesh_lib.replicate(mesh, params)
-            mesh_step = data_parallel.make_dp_step(
-                mesh, dt=tc.dt, global_batch=tc.batch_size,
-                compute_dtype=tc.dtype, ops_path=tc.ops,
-            )
+
+            def build_mesh_step(dt_):
+                step = data_parallel.make_dp_step(
+                    mesh, dt=dt_, global_batch=tc.batch_size,
+                    compute_dtype=tc.dtype, ops_path=tc.ops,
+                )
+                if tc.ops == "pallas" and res.pallas_fallback:
+                    step = with_fallback(
+                        step,
+                        data_parallel.make_dp_step(
+                            mesh, dt=dt_, global_batch=tc.batch_size,
+                            compute_dtype=tc.dtype, ops_path="reference",
+                        ),
+                        name="pallas DP step",
+                    )
+                return step
+
+        mesh_step = build_mesh_step(dt)
         if verbose:
             print(f"mesh: {dict(mesh.shape)}")
 
-    for epoch in range(tc.epochs):
+    if sentinel is not None:
+        # The pre-training state is the first "last good": a divergence in
+        # epoch 0 still has something to skip/roll back to.
+        last_good = tree_copy(params)
+        if controller is not None:
+            controller.commit(params)
+
+    def _chaos_step(p, e):
+        return chaos.after_step(p, e) if chaos is not None else (p, e)
+
+    epoch = 0
+    while epoch < tc.epochs:
         # Per-epoch derived seed: every path reshuffles each epoch (and all
         # paths draw the same epoch boundary semantics — an epoch is one
         # pass from index 0, shuffled or in file order).
@@ -187,7 +261,9 @@ def learn(
                     ex, ey = images[perm], labels[perm]
                 else:
                     ex, ey = images, labels
-                params, err = step_lib.scan_epoch(params, ex, ey, tc.dt)
+                params, err = _chaos_step(
+                    *step_lib.scan_epoch(params, ex, ey, dt)
+                )
             elif steps_per_epoch > 0 and (
                 mesh_step is not None
                 or batcher_cls is not None
@@ -206,15 +282,15 @@ def learn(
                         # jnp.asarray first would commit the full batch to
                         # device 0 and pay a second transfer to reshard.
                         xs_, ys_ = mesh_lib.shard_batch(mesh, (bx, by))
-                        params, e = mesh_step(params, xs_, ys_)
+                        params, e = _chaos_step(*mesh_step(params, xs_, ys_))
                     else:
-                        params, e = batched_step(
+                        params, e = _chaos_step(*batched_step(
                             params,
                             jnp.asarray(bx),
                             jnp.asarray(by),
-                            tc.dt,
+                            dt,
                             compute_dtype=tc.dtype,
-                        )
+                        ))
                     errs.append(e)
                 err = jnp.mean(jnp.stack(errs))
             else:
@@ -228,21 +304,57 @@ def learn(
                     seed=epoch_seed,
                     drop_remainder=False,
                 ):
-                    params, e = batched_step(
+                    params, e = _chaos_step(*batched_step(
                         params,
                         jnp.asarray(bx),
                         jnp.asarray(by),
-                        tc.dt,
+                        dt,
                         compute_dtype=tc.dtype,
-                    )
+                    ))
                     errs.append(e)
                     weights.append(bx.shape[0])
                 w = jnp.asarray(weights, jnp.float32)
                 err = jnp.sum(jnp.stack(errs) * w) / jnp.sum(w)
             err = float(err)  # blocks: everything above is async
+
+        if sentinel is not None:
+            verdict = sentinel.check(loss=err, params=params)
+            if not verdict.healthy:
+                g_epoch = epoch_offset + epoch + 1
+                if res.policy == "raise":
+                    raise DivergenceError(
+                        f"epoch {g_epoch}: {verdict.reason}"
+                    )
+                if res.policy == "skip":
+                    log.warning(
+                        "sentinel: %s at epoch %d — discarding the "
+                        "epoch's update, continuing from last-good",
+                        verdict.reason, g_epoch,
+                    )
+                    params = tree_copy(last_good)
+                    epoch += 1
+                    continue
+                # rollback: restore newest healthy state, scale the LR,
+                # retry the SAME epoch (bounded by max_rollbacks).
+                params, _ = controller.rollback(
+                    like=params, reason=f"epoch {g_epoch}: {verdict.reason}"
+                )
+                result.rollbacks = controller.rollbacks
+                new_dt = tc.dt * controller.lr_scale
+                if new_dt != dt:
+                    dt = new_dt
+                    if build_mesh_step is not None:
+                        mesh_step = build_mesh_step(dt)
+                continue
+            last_good = tree_copy(params)
+            if controller is not None:
+                controller.commit(params)
+
         result.epoch_errors.append(err)
         if epoch_callback is not None:
             epoch_callback(epoch_offset + epoch + 1, params, err)
+        if chaos is not None:
+            chaos.at_epoch(epoch_offset + epoch + 1)
         if verbose:
             # ≙ fprintf at Sequential/Main.cpp:174
             print(f"error: {err:e}, time_on_cpu: {sw.total:f}")
@@ -252,6 +364,18 @@ def learn(
                 # ≙ Sequential/Main.cpp:177
                 print("Training complete, error less than threshold\n")
             break
+        if preempt.requested():
+            # The epoch_callback above already flushed this epoch's
+            # checkpoint; stop at the boundary and let the driver exit
+            # cleanly (--resume continues bit-exactly).
+            result.preempted = True
+            if verbose:
+                print(
+                    f"preemption: stopping after epoch "
+                    f"{epoch_offset + epoch + 1} (checkpoint flushed)"
+                )
+            break
+        epoch += 1
 
     result.params = params
     result.seconds = sw.total

@@ -305,6 +305,8 @@ def train(
     metrics=None,
     loader: str = "device",
     profile_trace_dir: Optional[str] = None,
+    resilience=None,
+    chaos=None,
 ):
     """Epoch driver for zoo models on an in-memory dataset.
 
@@ -350,6 +352,20 @@ def train(
       of params/optimizer/BN stats over the mesh's ``model`` axis
       (parallel/zoo_sharding.py) composed with DP — hybrid 2-D training.
 
+    - ``resilience`` (a config.ResilienceConfig): health-sentinel policy
+      over the epoch loss and params — and, when ``check_every_steps``
+      is set, every N optimizer steps (each check is a host sync; the
+      default 0 keeps step dispatch fully asynchronous). "skip" discards
+      a poisoned epoch; "rollback" restores the last-good ``ZooState``
+      and retries the epoch (deterministic: shuffles derive from
+      ``seed + epoch``), bounded by ``max_rollbacks``. LR backoff does
+      not apply here — the zoo LR is baked into the jitted optimizer
+      schedule, so rollback retries at the same LR. ``ring_size`` prunes
+      the per-epoch checkpoints to the newest N. A preemption signal
+      (resilience/preempt) stops the loop at the next epoch boundary
+      after the checkpoint flush. ``chaos`` is the fault injector used
+      by tests/test_resilience.py.
+
     Returns (ZooState, list of per-epoch mean losses).
     """
     if loader not in ("device", "native"):
@@ -378,6 +394,25 @@ def train(
     )
     ev_step = make_eval_step(model) if eval_data is not None else None
 
+    from parallel_cnn_tpu.resilience import preempt
+    from parallel_cnn_tpu.resilience.rollback import (
+        CheckpointRing,
+        RollbackController,
+        tree_copy,
+    )
+    from parallel_cnn_tpu.resilience.sentinel import DivergenceError, Sentinel
+
+    res = resilience
+    sentinel = Sentinel() if res is not None and res.policy != "off" else None
+    controller = None
+    if sentinel is not None and res.policy == "rollback":
+        controller = RollbackController(max_rollbacks=res.max_rollbacks)
+    ring = None
+    if checkpoint_dir:
+        ring = CheckpointRing(
+            checkpoint_dir, keep=res.ring_size if res is not None else 0
+        )
+
     start_epoch = 0
     losses: list = []
     accs: list = []
@@ -405,11 +440,18 @@ def train(
         images = jnp.asarray(images)
         labels = jnp.asarray(labels)
     aug_base = jax.random.key(seed ^ 0x5EED)
-    for epoch in range(start_epoch, epochs):
+    if sentinel is not None:
+        last_good = tree_copy(state)
+        if controller is not None:
+            controller.commit(state)
+    epoch = start_epoch
+    while epoch < epochs:
         t0 = time.perf_counter()
         # Device-side loss accumulation: one host readback per epoch, so
         # step dispatch stays asynchronous (same discipline as
-        # trainer.learn's single per-epoch float()).
+        # trainer.learn's single per-epoch float()). The opt-in per-step
+        # sentinel cadence (res.check_every_steps) trades that asynchrony
+        # for early divergence detection.
         epoch_loss = jnp.float32(0.0)
         if loader == "native":
             batches = _native_epoch_batches(
@@ -422,6 +464,7 @@ def train(
                  labels[perm[i * batch_size : (i + 1) * batch_size]])
                 for i in range(steps)
             )
+        diverged = None
         for i, (bx, by) in enumerate(batches):
             key = (
                 jax.random.fold_in(aug_base, epoch * steps + i)
@@ -429,8 +472,50 @@ def train(
                 else None
             )
             state, loss = step(state, jnp.asarray(bx), jnp.asarray(by), key)
+            if chaos is not None:
+                state, loss = chaos.after_step(state, loss)
             epoch_loss = epoch_loss + loss
-        losses.append(float(epoch_loss) / max(steps, 1))
+            if (
+                sentinel is not None
+                and res.check_every_steps
+                and (i + 1) % res.check_every_steps == 0
+            ):
+                verdict = sentinel.check(
+                    loss=float(loss), params=state.params
+                )
+                if not verdict.healthy:
+                    diverged = f"step {i} of epoch {epoch + 1}: " + (
+                        verdict.reason
+                    )
+                    break
+        mean_loss = float(epoch_loss) / max(steps, 1)
+        if diverged is None and sentinel is not None:
+            verdict = sentinel.check(loss=mean_loss, params=state.params)
+            if not verdict.healthy:
+                diverged = f"epoch {epoch + 1}: {verdict.reason}"
+        if diverged is not None:
+            if res.policy == "raise":
+                raise DivergenceError(diverged)
+            if res.policy == "skip":
+                if verbose:
+                    print(f"sentinel: {diverged} — epoch discarded")
+                state = tree_copy(last_good)
+                epoch += 1
+                continue
+            # rollback: restore the last-good ZooState and retry the same
+            # epoch (same seed → same shuffle/augment stream), bounded.
+            state, _ = controller.rollback(like=state, reason=diverged)
+            if verbose:
+                print(
+                    f"sentinel: {diverged} — rolled back "
+                    f"({controller.rollbacks}/{controller.max_rollbacks})"
+                )
+            continue
+        if sentinel is not None:
+            last_good = tree_copy(state)
+            if controller is not None:
+                controller.commit(state)
+        losses.append(mean_loss)
         seconds = time.perf_counter() - t0
         if eval_data is not None:
             accs.append(
@@ -443,11 +528,11 @@ def train(
             if eval_data is not None:
                 rec["accuracy"] = accs[-1]
             metrics.record(**rec)
-        if checkpoint_dir:
+        if ring is not None:
             from parallel_cnn_tpu.train import checkpoint
 
-            checkpoint.save(
-                os.path.join(checkpoint_dir, f"ckpt_{epoch + 1}.npz"),
+            ring.save(
+                epoch + 1,
                 state,
                 checkpoint.TrainState(
                     epoch=epoch + 1,
@@ -461,6 +546,15 @@ def train(
                 f"epoch {epoch + 1}: loss {losses[-1]:.4f}{acc_txt} "
                 f"({seconds:.2f}s)"
             )
+        if chaos is not None:
+            chaos.at_epoch(epoch + 1)
+        if preempt.requested():
+            # Checkpoint for this epoch is already flushed (ring.save
+            # above); stop at the boundary so --resume continues exactly.
+            if verbose:
+                print(f"preemption: stopping after epoch {epoch + 1}")
+            break
+        epoch += 1
 
     if profile_trace_dir:
         from parallel_cnn_tpu.utils import profiling

@@ -43,3 +43,7 @@ def rng():
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long end-to-end tests")
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection tests (resilience/chaos.py)",
+    )
