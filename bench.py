@@ -142,74 +142,27 @@ def _resolve_platform() -> str:
     # A CPU-fallback line scores as a missing TPU artifact (round-3
     # lesson: the relay died mid-round and BENCH_r03 landed on CPU), so
     # before conceding, keep re-probing with backoff for a wait window —
-    # transient relay outages often heal within minutes. Every probe runs
-    # in a subprocess with a hard timeout, so the never-hang contract
-    # holds throughout; PCNN_BENCH_TPU_WAIT=0 restores single-probe
-    # behavior. A probe that SUCCEEDS but reports a cpu-only backend
-    # (axon plugin loaded, no TPU exposed) counts as not-TPU and keeps
-    # waiting — that mode would otherwise reproduce BENCH_r03 exactly.
-    # Worst-case wall clock is therefore ADDITIVE: up to
-    # PCNN_BENCH_TPU_WAIT of probing, then the rows. A chip that heals
-    # late in the wait gets the FULL row budget (that's the point of
-    # waiting); only a failed wait is deducted (main() floors the
-    # fallback at ~180 s so a labeled CPU line still prints fast). A
-    # driver's patience must cover PCNN_BENCH_TPU_WAIT +
-    # PCNN_BENCH_TIME_BUDGET, not PCNN_BENCH_TIME_BUDGET alone.
+    # transient relay outages often heal within minutes. The probe loop
+    # itself (subprocess probes with hard timeouts, the two-clean-cpu
+    # concession, the 15 s → 60 s backoff ramp shared with
+    # benches/watch.py) lives in utils/probe.py — ONE implementation for
+    # bench and watcher, with the probe subprocess PYTHONPATH handled
+    # append-never-assign (the round-5 clobber trap).
+    # PCNN_BENCH_TPU_WAIT=0 restores single-probe behavior. Worst-case
+    # wall clock is ADDITIVE: up to PCNN_BENCH_TPU_WAIT of probing, then
+    # the rows. A chip that heals late in the wait gets the FULL row
+    # budget (that's the point of waiting); only a failed wait is
+    # deducted (main() floors the fallback at ~180 s so a labeled CPU
+    # line still prints fast). A driver's patience must cover
+    # PCNN_BENCH_TPU_WAIT + PCNN_BENCH_TIME_BUDGET.
     wait_budget = float(os.environ.get("PCNN_BENCH_TPU_WAIT", "600"))
-    t_probe0 = time.perf_counter()
-    attempt = 0
-    healthy = False
-    clean_cpu_streak = 0
-    while True:
-        attempt += 1
-        try:
-            # Two lines: the configured platform list (the axon
-            # sitecustomize hook sets e.g. "axon,cpu"), then the live
-            # default device's platform. A clean probe that reports cpu
-            # with NO non-cpu platform configured means there is
-            # probably no TPU plugin to wait FOR — concede after TWO
-            # consecutive such probes instead of burning the whole wait
-            # budget on a plain CPU box. (Two, not one: on a TPU VM
-            # whose plugin failed transiently, jax_platforms is also
-            # unset and the first probe can report cpu — the second
-            # probe after backoff catches the heal. A flaky axon relay,
-            # by contrast, either hangs the probe or shows a non-cpu
-            # entry in the platform list and keeps the full wait.)
-            proc = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; print(jax.config.jax_platforms or '');"
-                 " print(jax.devices()[0].platform)"],
-                capture_output=True,
-                text=True,
-                timeout=timeout,
-            )
-            lines = proc.stdout.splitlines() if proc.returncode == 0 else []
-            configured = lines[0].strip() if len(lines) >= 2 else ""
-            probed = lines[-1].strip() if lines else ""
-            healthy = bool(probed) and probed != "cpu"
-            if not healthy and lines and not any(
-                p and p != "cpu" for p in configured.split(",")
-            ):
-                clean_cpu_streak += 1
-                if clean_cpu_streak >= 2:
-                    break  # plain CPU environment: nothing to wait for
-            else:
-                clean_cpu_streak = 0
-        except (subprocess.TimeoutExpired, OSError):
-            healthy = False
-            clean_cpu_streak = 0
-        if healthy:
-            break
-        remaining = wait_budget - (time.perf_counter() - t_probe0)
-        if remaining <= 0:
-            break
-        backoff = min(15.0 * attempt, 60.0, remaining)
-        print(
-            f"[bench] backend probe {attempt} found no TPU; retrying in "
-            f"{backoff:.0f}s ({remaining:.0f}s of TPU wait budget left)",
-            file=sys.stderr, flush=True,
-        )
-        time.sleep(backoff)
+    from parallel_cnn_tpu.utils.probe import wait_for_tpu
+
+    healthy = wait_for_tpu(
+        wait_budget=wait_budget,
+        timeout=timeout,
+        log=lambda m: print(f"[bench] {m}", file=sys.stderr, flush=True),
+    )
 
     if not healthy:
         jax.config.update("jax_platforms", "cpu")
@@ -384,15 +337,16 @@ def main() -> None:
     # varies ±20% run-to-run through the relay, so the headline is the
     # MEDIAN of N same-session samples, with the min–max range reported
     # alongside. Each sample is a full _time_epochs measurement (warmed,
-    # chained, RTT-corrected). N=1 on the CPU fallback (no relay there,
-    # and the fallback should stay cheap).
+    # chained, RTT-corrected). N=5 on-chip (round 6: three samples left
+    # the range wider than the effect sizes being claimed); N=1 on the
+    # CPU fallback (no relay there, and the fallback should stay cheap).
     def median(xs):
         s = sorted(xs)
         n = len(s)
         return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
 
     n_samples = int(os.environ.get(
-        "PCNN_BENCH_SAMPLES", "3" if platform == "tpu" else "1"
+        "PCNN_BENCH_SAMPLES", "5" if platform == "tpu" else "1"
     ))
 
     def sample_ips(epoch_fn, n):

@@ -20,7 +20,13 @@ line was produced) is retried at ``--interval``, not ``--cooldown``:
 healed-chip windows are the scarce resource.
 
 Probe/run/sleep are injectable for tests (tests/test_watch.py mocks all
-three; no TPU or subprocess needed to verify the loop logic).
+three; no TPU or subprocess needed to verify the loop logic). The probe
+itself is the SHARED implementation in parallel_cnn_tpu/utils/probe.py —
+bench.py's wait loop uses the same one, so the two tools can't drift on
+what "healthy" means, and the probe subprocess appends (never assigns)
+the repo root onto PYTHONPATH. The default --interval equals the shared
+RETRY_BACKOFF_CAP, aligning the watcher's poll with bench.py's
+backed-off retry schedule.
 
 Reference anchor: the reference committed measured numbers for every
 backend it shipped (README.md:17-18, PDF Tables 1-8); this is the tooling
@@ -35,28 +41,14 @@ import subprocess
 import sys
 import time
 
-_PROBE_SNIPPET = "import jax; print(jax.devices()[0].platform)"
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-
-def probe_once(timeout: float = 120.0, runner=subprocess.run) -> bool:
-    """True iff a fresh process sees a non-CPU default jax backend.
-
-    A probe that *succeeds* but reports ``cpu`` (axon plugin loaded, no
-    TPU exposed) counts as down — that mode is exactly what produced the
-    CPU-fallback BENCH_r03/r04 artifacts.
-    """
-    try:
-        proc = runner(
-            [sys.executable, "-c", _PROBE_SNIPPET],
-            capture_output=True,
-            text=True,
-            timeout=timeout,
-        )
-    except (subprocess.TimeoutExpired, OSError):
-        return False
-    out = (proc.stdout or "").strip().splitlines()
-    platform = out[-1] if out else ""
-    return proc.returncode == 0 and bool(platform) and platform != "cpu"
+from parallel_cnn_tpu.utils.probe import (  # noqa: E402
+    RETRY_BACKOFF_CAP,
+    probe_once,
+)
 
 
 def watch(
@@ -112,8 +104,10 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--tag", default=os.environ.get("PCNN_ROUND_TAG", ""),
                         help="artifact tag (docs/bench_lines_<tag>.jsonl etc.)")
-    parser.add_argument("--interval", type=float, default=240.0,
-                        help="seconds between probes while the chip is down")
+    parser.add_argument("--interval", type=float,
+                        default=RETRY_BACKOFF_CAP,
+                        help="seconds between probes while the chip is "
+                             "down (default: the shared probe retry cap)")
     parser.add_argument("--cooldown", type=float, default=3600.0,
                         help="seconds to wait after a successful playbook run")
     parser.add_argument("--max-runs", type=int, default=0,

@@ -64,7 +64,8 @@ class Conv2D(Module):
         return params, {}, tuple(out[1:])
 
     def apply(self, params, state, x, train: bool = False):
-        if self.backend == "pallas":
+        use_pallas = self.backend == "pallas"
+        if use_pallas:
             from parallel_cnn_tpu.ops import pallas_conv
 
             if not pallas_conv.supports(self.kernel, self.strides, self.padding):
@@ -72,6 +73,15 @@ class Conv2D(Module):
                     f"pallas conv backend does not cover kernel={self.kernel} "
                     f"strides={self.strides} padding={self.padding!r}"
                 )
+            # Env-gated stem→XLA hybrid (PCNN_PALLAS_STEM_XLA=1): the
+            # documented escape hatch if a Mosaic regression re-opens
+            # the huge-input stem compile pathology that row-band
+            # tiling closes (docs/kernel_authoring.md).
+            if pallas_conv.prefer_xla_fallback(
+                self.kernel, self.strides, x.shape
+            ):
+                use_pallas = False
+        if use_pallas:
             y = pallas_conv.conv2d(
                 x, params["w"].astype(x.dtype), self.strides[0]
             )
@@ -158,6 +168,90 @@ class BatchNorm(Module):
             + params["bias"].astype(x.dtype)
         )
         return y, state
+
+
+@dataclasses.dataclass(frozen=True)
+class ConvBNAct(Module):
+    """Conv2D(use_bias=False) → BatchNorm → (+ residual) → optional ReLU
+    as ONE module, so backend="pallas" can execute the entire layer tail
+    as a single fused kernel (`ops.pallas_conv.conv2d_fused`) in
+    inference mode: the running-stats BN folds to per-channel
+    scale/shift, and the residual add + ReLU ride the conv kernel's f32
+    accumulator before its only HBM write — one round-trip per layer
+    instead of three-to-four (≙ the reference CUDA kernels' fused
+    bias+activation, CUDA/layer.cu:151-165).
+
+    Training keeps the exact unfused composition: train-mode BN
+    statistics are reductions OVER the conv output, so a one-pass
+    fusion is mathematically impossible without changing the batch-stat
+    semantics (docs/kernel_authoring.md). Gradients through the fused
+    eval path (e.g. frozen-BN fine-tuning) are exact — conv2d_fused
+    carries a full custom VJP.
+
+    `apply(..., residual=sc)` computes relu?(bn(conv(x)) + sc); the
+    fused-vs-unfused numerics differ only by f32 fold rounding (the
+    fused epilogue runs entirely on the f32 accumulator).
+    """
+
+    features: int
+    kernel: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (1, 1)
+    relu: bool = True
+    momentum: float = 0.9
+    eps: float = 1e-5
+    backend: str = "xla"
+
+    def _conv(self) -> Conv2D:
+        return Conv2D(
+            self.features,
+            kernel=self.kernel,
+            strides=self.strides,
+            padding="SAME",
+            use_bias=False,
+            backend=self.backend,
+        )
+
+    def _bn(self) -> BatchNorm:
+        return BatchNorm(momentum=self.momentum, eps=self.eps)
+
+    def init(self, key, in_shape: Shape):
+        k1, k2 = jax.random.split(key)
+        cp, _, shape = self._conv().init(k1, in_shape)
+        bp, bs, shape = self._bn().init(k2, shape)
+        return {"conv": cp, "bn": bp}, {"bn": bs}, shape
+
+    def apply(self, params, state, x, train: bool = False, residual=None):
+        if self.backend == "pallas" and not train:
+            from parallel_cnn_tpu.ops import pallas_conv
+
+            if pallas_conv.supports(
+                self.kernel, self.strides, "SAME"
+            ) and not pallas_conv.prefer_xla_fallback(
+                self.kernel, self.strides, x.shape
+            ):
+                bn_s = state["bn"]
+                # Folded inference-mode BN: y = conv·scale + shift.
+                scale = params["bn"]["scale"] * lax.rsqrt(
+                    bn_s["var"] + self.eps
+                )
+                shift = params["bn"]["bias"] - bn_s["mean"] * scale
+                y = pallas_conv.conv2d_fused(
+                    x,
+                    params["conv"]["w"].astype(x.dtype),
+                    scale,
+                    shift,
+                    residual,
+                    self.strides[0],
+                    self.relu,
+                )
+                return y, state
+        y, _ = self._conv().apply(params["conv"], {}, x, train)
+        y, bn_s = self._bn().apply(params["bn"], state["bn"], y, train)
+        if residual is not None:
+            y = y + residual
+        if self.relu:
+            y = jax.nn.relu(y)
+        return y, {"bn": bn_s}
 
 
 @dataclasses.dataclass(frozen=True)

@@ -3,150 +3,150 @@ ResNet-50 on ImageNet-1k) — He et al. 2016, built from this package's
 layers with a functional residual-block module.
 
 CIFAR variants use the 3×3/stride-1 stem (no maxpool); ImageNet variants
-the 7×7/stride-2 stem + 3×3 maxpool, per the paper."""
+the 7×7/stride-2 stem + 3×3 maxpool, per the paper.
+
+Round 6: blocks are built from `ConvBNAct` units (conv→BN→[+residual]→
+relu as one module) so the branch TAILS — the BN, the shortcut add, and
+the post-add ReLU — execute inside the conv kernel's epilogue on the
+pallas backend in inference mode (`ops.pallas_conv.conv2d_fused`): one
+HBM round-trip per layer instead of three-to-four. Both backends share
+the module structure, so parameter trees stay identical across
+conv_backend choices (the cross-backend parity tests zip leaves
+strictly)."""
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Sequence, Tuple
+from typing import Sequence
 
 import jax
 
 from parallel_cnn_tpu.nn.core import Module, Sequential, Shape
 from parallel_cnn_tpu.nn.layers import (
-    BatchNorm,
-    Conv2D,
+    ConvBNAct,
     Dense,
     GlobalAvgPool,
     MaxPool,
-    ReLU,
 )
 
 
 @dataclasses.dataclass(frozen=True)
 class BasicBlock(Module):
-    """Two 3×3 convs + identity/projection shortcut (ResNet-18/34)."""
+    """Two 3×3 convs + identity/projection shortcut (ResNet-18/34).
+
+    The shortcut feeds the tail ConvBNAct as its fused residual: the
+    add and the post-add ReLU live in the second conv's epilogue."""
 
     features: int
     stride: int = 1
     conv_backend: str = "xla"
 
-    def _branches(self):
-        main = Sequential(
-            [
-                Conv2D(self.features, strides=(self.stride, self.stride),
-                       use_bias=False, backend=self.conv_backend),
-                BatchNorm(),
-                ReLU(),
-                Conv2D(self.features, use_bias=False,
-                       backend=self.conv_backend),
-                BatchNorm(),
-            ]
+    def _parts(self):
+        head = ConvBNAct(
+            self.features, strides=(self.stride, self.stride),
+            backend=self.conv_backend,
         )
-        proj = Sequential(
-            [
-                Conv2D(
-                    self.features,
-                    kernel=(1, 1),
-                    strides=(self.stride, self.stride),
-                    use_bias=False,
-                    backend=self.conv_backend,
-                ),
-                BatchNorm(),
-            ]
+        tail = ConvBNAct(self.features, backend=self.conv_backend)
+        proj = ConvBNAct(
+            self.features, kernel=(1, 1),
+            strides=(self.stride, self.stride), relu=False,
+            backend=self.conv_backend,
         )
-        return main, proj
+        return head, tail, proj
 
     def init(self, key, in_shape: Shape):
-        main, proj = self._branches()
+        head, tail, proj = self._parts()
         k1, k2 = jax.random.split(key)
-        mp, ms, out_shape = main.init(k1, in_shape)
-        params = {"main": mp}
-        state = {"main": ms}
+        k1a, k1b = jax.random.split(k1)
+        hp, hs, mid_shape = head.init(k1a, in_shape)
+        tp, ts, out_shape = tail.init(k1b, mid_shape)
+        params = {"main": [hp, tp]}
+        state = {"main": [hs, ts]}
         if self.stride != 1 or in_shape[-1] != self.features:
             pp, ps, _ = proj.init(k2, in_shape)
-            params["proj"] = pp
-            state["proj"] = ps
+            params["proj"] = [pp]
+            state["proj"] = [ps]
         return params, state, out_shape
 
     def apply(self, params, state, x, train: bool = False):
-        main, proj = self._branches()
-        y, ms = main.apply(params["main"], state["main"], x, train)
-        new_state = {"main": ms}
+        head, tail, proj = self._parts()
         if "proj" in params:
-            sc, ps = proj.apply(params["proj"], state["proj"], x, train)
-            new_state["proj"] = ps
+            sc, ps = proj.apply(
+                params["proj"][0], state["proj"][0], x, train
+            )
         else:
             sc = x
-        return jax.nn.relu(y + sc), new_state
+        y, hs = head.apply(params["main"][0], state["main"][0], x, train)
+        y, ts = tail.apply(
+            params["main"][1], state["main"][1], y, train, residual=sc
+        )
+        new_state = {"main": [hs, ts]}
+        if "proj" in params:
+            new_state["proj"] = [ps]
+        return y, new_state
 
 
 @dataclasses.dataclass(frozen=True)
 class Bottleneck(Module):
-    """1×1 → 3×3 → 1×1(×4) bottleneck (ResNet-50/101/152)."""
+    """1×1 → 3×3 → 1×1(×4) bottleneck (ResNet-50/101/152); the wide
+    final 1×1's epilogue carries the shortcut add + ReLU."""
 
     features: int  # bottleneck width; output is 4× this
     stride: int = 1
     conv_backend: str = "xla"
     EXPANSION = 4
 
-    def _branches(self):
+    def _parts(self):
         out_ch = self.features * self.EXPANSION
-        main = Sequential(
-            [
-                Conv2D(self.features, kernel=(1, 1), use_bias=False,
-                       backend=self.conv_backend),
-                BatchNorm(),
-                ReLU(),
-                Conv2D(
-                    self.features,
-                    strides=(self.stride, self.stride),
-                    use_bias=False,
-                    backend=self.conv_backend,
-                ),
-                BatchNorm(),
-                ReLU(),
-                Conv2D(out_ch, kernel=(1, 1), use_bias=False,
-                       backend=self.conv_backend),
-                BatchNorm(),
-            ]
+        reduce = ConvBNAct(
+            self.features, kernel=(1, 1), backend=self.conv_backend
         )
-        proj = Sequential(
-            [
-                Conv2D(
-                    out_ch,
-                    kernel=(1, 1),
-                    strides=(self.stride, self.stride),
-                    use_bias=False,
-                    backend=self.conv_backend,
-                ),
-                BatchNorm(),
-            ]
+        mid = ConvBNAct(
+            self.features, strides=(self.stride, self.stride),
+            backend=self.conv_backend,
         )
-        return main, proj
+        expand = ConvBNAct(
+            out_ch, kernel=(1, 1), backend=self.conv_backend
+        )
+        proj = ConvBNAct(
+            out_ch, kernel=(1, 1),
+            strides=(self.stride, self.stride), relu=False,
+            backend=self.conv_backend,
+        )
+        return reduce, mid, expand, proj
 
     def init(self, key, in_shape: Shape):
-        main, proj = self._branches()
+        reduce, mid, expand, proj = self._parts()
         k1, k2 = jax.random.split(key)
-        mp, ms, out_shape = main.init(k1, in_shape)
-        params = {"main": mp}
-        state = {"main": ms}
+        ka, kb, kc = jax.random.split(k1, 3)
+        rp, rs, s1 = reduce.init(ka, in_shape)
+        mp, ms, s2 = mid.init(kb, s1)
+        ep, es, out_shape = expand.init(kc, s2)
+        params = {"main": [rp, mp, ep]}
+        state = {"main": [rs, ms, es]}
         if self.stride != 1 or in_shape[-1] != self.features * self.EXPANSION:
             pp, ps, _ = proj.init(k2, in_shape)
-            params["proj"] = pp
-            state["proj"] = ps
+            params["proj"] = [pp]
+            state["proj"] = [ps]
         return params, state, out_shape
 
     def apply(self, params, state, x, train: bool = False):
-        main, proj = self._branches()
-        y, ms = main.apply(params["main"], state["main"], x, train)
-        new_state = {"main": ms}
+        reduce, mid, expand, proj = self._parts()
         if "proj" in params:
-            sc, ps = proj.apply(params["proj"], state["proj"], x, train)
-            new_state["proj"] = ps
+            sc, ps = proj.apply(
+                params["proj"][0], state["proj"][0], x, train
+            )
         else:
             sc = x
-        return jax.nn.relu(y + sc), new_state
+        y, rs = reduce.apply(params["main"][0], state["main"][0], x, train)
+        y, ms = mid.apply(params["main"][1], state["main"][1], y, train)
+        y, es = expand.apply(
+            params["main"][2], state["main"][2], y, train, residual=sc
+        )
+        new_state = {"main": [rs, ms, es]}
+        if "proj" in params:
+            new_state["proj"] = [ps]
+        return y, new_state
 
 
 def _stage(
@@ -166,22 +166,17 @@ def _resnet(
     conv_backend: str = "xla",
 ) -> Sequential:
     if cifar_stem:
-        stem = [
-            Conv2D(64, use_bias=False, backend=conv_backend),
-            BatchNorm(),
-            ReLU(),
-        ]
+        stem = [ConvBNAct(64, backend=conv_backend)]
     else:
         # Round 4: the 7×7-stride-2 stem joined the pallas kernel
         # library's coverage (ops/pallas_conv.py generalized tap
         # geometry), so conv_backend="pallas" now puts EVERY conv in
-        # ResNet-50 on hand-written kernels. MaxPool stays XLA (pooling,
-        # not conv).
+        # ResNet-50 on hand-written kernels; round 6 band-tiles its
+        # rows so the 224² layout compiles in minutes and fuses its
+        # BN+ReLU tail in eval. MaxPool stays XLA (pooling, not conv).
         stem = [
-            Conv2D(64, kernel=(7, 7), strides=(2, 2), use_bias=False,
-                   backend=conv_backend),
-            BatchNorm(),
-            ReLU(),
+            ConvBNAct(64, kernel=(7, 7), strides=(2, 2),
+                      backend=conv_backend),
             MaxPool(window=(3, 3), strides=(2, 2), padding="SAME"),
         ]
     layers = list(stem)

@@ -56,17 +56,48 @@ The same generic kernel body serves all three conv derivatives:
 wired together with `jax.custom_vjp`, so `jax.grad` through the zoo
 trainer uses Pallas for every conv FLOP.
 
+Round-6 additions (ISSUE 2, the round-5 verdict's perf mandate):
+
+- **Fused epilogues** (≙ the reference CUDA kernels' fused
+  bias+activation, CUDA/layer.cu:151-165): `conv2d_fused` applies
+  per-channel scale+shift (folded inference-mode BN), an optional
+  residual add, and ReLU on the f32 accumulator INSIDE the kernel's
+  output block, before the single HBM write — one round-trip per layer
+  tail instead of three-to-four. The VJP recomputes the cheap
+  elementwise tail in XLA from the saved conv output (ReLU mask +
+  residual pass-through) and routes the conv cotangent through the
+  existing `_conv2d_bwd` kernels.
+
+- **Double-buffered weight streaming**: when cout is large
+  (multiple of `_COUT_TILE`) the weight stack no longer sits resident;
+  a second, minor grid dimension walks cout tiles and Pallas's grid
+  pipeline prefetches tile j+1's weight block while tile j multiplies.
+  The x blocks keep a constant index along that dimension, so Mosaic
+  skips their re-DMA. `_pick_bb` counts both in-flight weight buffers
+  (the existing `2·w_bytes` term) against the per-tile bytes.
+
+- **Row-band spatial tiling**: layouts whose per-image flat rows
+  exceed `_MAX_ROWS_PER_IMG` (the 7×7-s2 stem at 224²: 49 taps ×
+  12880 rows was Mosaic-compile-pathological, >25 min) are split into
+  H-bands with a real-data halo; each band is its own kernel call and
+  the results concatenate along H. Interior halo rows read true
+  neighbor pixels, exterior ones the usual zero pads, so the math is
+  exact — only compile-unit size changes.
+
 Scope (documented, enforced): odd kernel 1/3/5/7, stride 1 or 2, SAME
 padding, NHWC; stride-2 for k>3 requires even spatial dims. Everything
 else falls back to XLA (`nn.layers.Conv2D` keeps backend="xla" as
-default).
+default). `PCNN_PALLAS_STEM_XLA=1` additionally reroutes huge-input
+k≥7 stems to XLA (`prefer_xla_fallback`) should a Mosaic regression
+re-open the compile pathology that banding closes.
 """
 
 from __future__ import annotations
 
 import functools
 import logging
-from typing import List, Sequence, Tuple
+import os
+from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -94,6 +125,39 @@ log = logging.getLogger(__name__)
 # raised limit stays as safety margin over the model.
 _VMEM_BUDGET = 32 * 1024 * 1024
 _VMEM_LIMIT = 100 * 1024 * 1024
+
+# Weight-streaming tile (lanes): couts that are a strict multiple get a
+# second grid dimension walking cout tiles — the grid pipeline then
+# double-buffers the weight DMA (prefetch tile j+1 while j multiplies)
+# instead of holding the whole stack resident. 0 disables.
+_COUT_TILE = int(os.environ.get("PCNN_PALLAS_COUT_TILE", "256"))
+
+# Row-band tiling threshold: per-image flat rows above this split into
+# H-bands, each its own kernel call (Mosaic compile time scales with
+# taps × rows; the 224² stem's 49 × 12880 was pathological). 6144 keeps
+# every ≤64² zoo shape single-band.
+_MAX_ROWS_PER_IMG = int(os.environ.get("PCNN_PALLAS_MAX_ROWS_PER_IMG",
+                                       "6144"))
+
+# Env-gated stem→XLA hybrid (see prefer_xla_fallback).
+_STEM_XLA = os.environ.get("PCNN_PALLAS_STEM_XLA", "0") not in ("", "0")
+
+
+class Epilogue(NamedTuple):
+    """Static spec for the in-kernel output-block epilogue.
+
+    The kernel applies, on the f32 accumulator and in this order:
+    ``z = acc·scale + shift``  (per-channel, folded inference-mode BN),
+    ``z += residual``          (if ``residual``),
+    ``z = max(z, 0)``          (if ``relu``),
+    then writes ``z`` as the (only) y output. ``emit_preact`` adds a
+    second output carrying the raw conv accumulator — the VJP's saved
+    activation — at the cost of the extra HBM write, so the primal
+    (inference) call never pays it."""
+
+    relu: bool = True
+    residual: bool = False
+    emit_preact: bool = False
 
 # A tap: (input_ref_index, flat_row_offset, column_shift, weight_slot).
 # column_shift is the tap's horizontal pixel shift: output rows whose
@@ -161,11 +225,15 @@ def _build_plan(taps_per_out, w_stack, cout):
     return plans, jnp.stack(pair_ws)
 
 
-def _tap_kernel(plan_per_out, w_col, lo, tail, n_in, have_pairs, *refs):
+def _tap_kernel(plan_per_out, w_col, lo, tail, n_in, have_pairs, ep, *refs):
     """Generic multi-ref, multi-output tapped matmul.
 
-    refs = (x_ref_0..x_ref_{n_in-1}, w_ref[, wp_ref], o_ref_0..). Plan
-    entries per output:
+    refs = (x_ref_0..x_ref_{n_in-1}, w_ref[, wp_ref][, ss_ref][,
+    res_ref], o_ref_0..). With an `ep: Epilogue`, ss_ref is an (8, cout)
+    f32 block (row 0 scale, row 1 shift; 8 rows keep the f32 sublane
+    tile legal when cout-tiling blocks it) and res_ref shares the output
+    flat layout — its halo rows, like the output's, are never touched.
+    Plan entries per output:
       ("s", (ridx, off, shift, slot))  —
         acc += mask ⊙ (x_refs[ridx][lo+off : hi+off] @ w_ref[slot])
       ("p", ridx, off1, s1, off2, s2, pslot)  —  N-PAIRED taps (r5,
@@ -185,8 +253,19 @@ def _tap_kernel(plan_per_out, w_col, lo, tail, n_in, have_pairs, *refs):
     """
     x_refs = refs[:n_in]
     w_ref = refs[n_in]
-    wp_ref = refs[n_in + 1] if have_pairs else None
-    o_refs = refs[n_in + 1 + (1 if have_pairs else 0):]
+    i = n_in + 1
+    wp_ref = None
+    if have_pairs:
+        wp_ref = refs[i]
+        i += 1
+    ss_ref = res_ref = None
+    if ep is not None:
+        ss_ref = refs[i]
+        i += 1
+        if ep.residual:
+            res_ref = refs[i]
+            i += 1
+    o_refs = refs[i:]
     nb = o_refs[0].shape[0]
     lo_, hi = lo, nb - tail
     masks = _col_masks(
@@ -223,7 +302,20 @@ def _tap_kernel(plan_per_out, w_col, lo, tail, n_in, have_pairs, *refs):
                     p2 = jnp.where(masks[s2], p2, 0.0)
                 part = p1 + p2
             acc = part if acc is None else acc + part
-        o_ref[lo_:hi, :] = acc.astype(o_ref.dtype)
+        if ep is None:
+            o_ref[lo_:hi, :] = acc.astype(o_ref.dtype)
+            continue
+        # Fused epilogue, all on the f32 accumulator before the single
+        # HBM write: (1, cout) × (rows, cout) broadcasts are the same
+        # rank-2 VPU shape the column masks use (lane-major variant).
+        z = acc * ss_ref[0:1, :] + ss_ref[1:2, :]
+        if ep.residual:
+            z = z + res_ref[lo_:hi, :].astype(jnp.float32)
+        if ep.relu:
+            z = jnp.maximum(z, 0.0)
+        o_ref[lo_:hi, :] = z.astype(o_ref.dtype)
+        if ep.emit_preact:
+            o_refs[1][lo_:hi, :] = acc.astype(o_refs[1].dtype)
 
 
 def _wgrad_tap_kernel(taps, w_col, lo, tail, n_in, *refs):
@@ -339,8 +431,26 @@ def _tapped_matmul(
     tail: int,
     couts: Sequence[int],
     out_dtype,
+    *,
+    epilogue: Optional[Epilogue] = None,
+    ss: Optional[jax.Array] = None,
+    res_flat: Optional[jax.Array] = None,
 ) -> List[jax.Array]:
-    """Run the generic forward/dgrad kernel over the batch grid."""
+    """Run the generic forward/dgrad kernel over the batch grid.
+
+    With `epilogue`, `ss` is the (8, cout) f32 scale/shift block and
+    `res_flat` (iff epilogue.residual) shares the OUTPUT flat layout;
+    outputs become [y] or [y, preact].
+
+    Weight streaming: when every output shares one cout that is a
+    strict multiple of `_COUT_TILE` (and the N-pair path is off — that
+    path only exists at cout ≤ 64), the grid gains a minor cout-tile
+    dimension. The weight blocks walk tiles along it while the x-block
+    index map stays constant, so Pallas's grid pipeline prefetches the
+    NEXT weight tile during the current tile's dots and skips the x
+    re-DMA — double-buffered weight streaming with no kernel-body
+    change. `_pick_bb`'s `2·w_bytes` term then counts the two in-flight
+    per-tile buffers instead of a resident full stack."""
     n = x_flats[0].shape[0] // rows_per_img
     n_in = len(x_flats)
     cins = [x.shape[1] for x in x_flats]
@@ -363,48 +473,107 @@ def _tapped_matmul(
         (sum(1 for e in plan if e[0] == "p") for plan in plan_per_out),
         default=0,
     )
+    cout0 = couts[0]
+    tile_c = 0
+    if (
+        _COUT_TILE
+        and not have_pairs
+        and len(set(couts)) == 1
+        and cout0 % _COUT_TILE == 0
+        and cout0 > _COUT_TILE
+        and w_stack.shape[-1] == cout0
+    ):
+        tile_c = _COUT_TILE
+    lane = tile_c or cout0
+    out_couts = list(couts)
+    if epilogue is not None and epilogue.emit_preact:
+        out_couts = out_couts + [cout0]
     # Both weight stacks ride the grid double-buffered: the paired
     # (wp_stack) bytes count against VMEM exactly like the singles.
+    # Under cout tiling only one TILE's bytes is in flight (×2 buffers).
     w_bytes = w_stack.size * w_stack.dtype.itemsize
     if have_pairs:
         w_bytes += wp_stack.size * wp_stack.dtype.itemsize
+    if tile_c:
+        w_bytes = (w_bytes * tile_c) // cout0
+    if epilogue is not None:
+        w_bytes += 8 * lane * 4  # the (8, lane) f32 scale/shift block
+    model_cins = list(cins)
+    if res_flat is not None:
+        model_cins.append(lane)  # residual rides the input pipeline
     bb = _pick_bb(
-        n, rows_per_img, cins, tap_cins, couts, esz,
+        n, rows_per_img, model_cins, tap_cins,
+        [lane] * len(out_couts),
+        esz,
         jnp.dtype(out_dtype).itemsize,
         w_bytes,
         pair_temps=max_pairs,
     )
     w_inputs = [w_stack] + ([wp_stack] if have_pairs else [])
-    outs = pl.pallas_call(
-        functools.partial(
-            _tap_kernel, plan_per_out, w_col, lo, tail, n_in, have_pairs
-        ),
-        grid=(n // bb,),
-        in_specs=[
+    extras = []
+    extra_specs = []
+    if tile_c:
+        nct = cout0 // tile_c
+        grid = (n // bb, nct)  # minor dim last → weight tiles stream
+        x_map = lambda g, j: (g, 0)  # noqa: E731 — constant along j
+        out_map = lambda g, j: (g, j)  # noqa: E731
+        w_specs = [
             pl.BlockSpec(
-                (bb * rows_per_img, c), lambda g: (g, 0),
+                w.shape[:-1] + (tile_c,),
+                lambda g, j, nd=w.ndim: (0,) * (nd - 1) + (j,),
                 memory_space=pltpu.VMEM,
             )
-            for c in cins
-        ] + [
+            for w in w_inputs
+        ]
+        ss_spec = pl.BlockSpec((8, tile_c), lambda g, j: (0, j),
+                               memory_space=pltpu.VMEM)
+    else:
+        grid = (n // bb,)
+        x_map = lambda g: (g, 0)  # noqa: E731
+        out_map = lambda g: (g, 0)  # noqa: E731
+        w_specs = [
             pl.BlockSpec(w.shape, lambda g, nd=w.ndim: (0,) * nd,
                          memory_space=pltpu.VMEM)
             for w in w_inputs
-        ],
-        out_specs=[
+        ]
+        ss_spec = pl.BlockSpec((8, cout0), lambda g: (0, 0),
+                               memory_space=pltpu.VMEM)
+    if epilogue is not None:
+        extras.append(ss)
+        extra_specs.append(ss_spec)
+        if epilogue.residual:
+            extras.append(res_flat)
+            extra_specs.append(
+                pl.BlockSpec((bb * rows_per_img, lane), out_map,
+                             memory_space=pltpu.VMEM)
+            )
+    outs = pl.pallas_call(
+        functools.partial(
+            _tap_kernel, plan_per_out, w_col, lo, tail, n_in, have_pairs,
+            epilogue,
+        ),
+        grid=grid,
+        in_specs=[
             pl.BlockSpec(
-                (bb * rows_per_img, c), lambda g: (g, 0),
+                (bb * rows_per_img, c), x_map,
                 memory_space=pltpu.VMEM,
             )
-            for c in couts
+            for c in cins
+        ] + w_specs + extra_specs,
+        out_specs=[
+            pl.BlockSpec(
+                (bb * rows_per_img, tile_c or c), out_map,
+                memory_space=pltpu.VMEM,
+            )
+            for c in out_couts
         ],
         out_shape=[
             jax.ShapeDtypeStruct((n * rows_per_img, c), out_dtype)
-            for c in couts
+            for c in out_couts
         ],
         interpret=_interpret(),
         compiler_params=_compiler_params(),
-    )(*x_flats, *w_inputs)
+    )(*x_flats, *w_inputs, *extras)
     return outs
 
 
@@ -484,6 +653,123 @@ def _flatten_padded(x: jax.Array, t_top: int, t_bot: int) -> jax.Array:
     return x.reshape(b * (h + t_top + t_bot) * w, c)
 
 
+def _bands(h: int, rows_single: int, t_top: int, t_bot: int,
+           w_col: int) -> List[Tuple[int, int]]:
+    """Split output H-rows [0, h) into bands whose flat layouts stay
+    under _MAX_ROWS_PER_IMG (Mosaic compile time scales with
+    taps × rows — the 224² stem pathology). Bands are ceil-equal so at
+    most two distinct kernel shapes compile."""
+    if rows_single <= _MAX_ROWS_PER_IMG:
+        return [(0, h)]
+    cap_h = max(1, _MAX_ROWS_PER_IMG // w_col - t_top - t_bot)
+    n_bands = -(-h // cap_h)
+    hb = -(-h // n_bands)
+    return [(r0, min(r0 + hb, h)) for r0 in range(0, h, hb)]
+
+
+def _flatten_band(x: jax.Array, r0: int, r1: int, t_top: int,
+                  t_bot: int) -> jax.Array:
+    """Flat rows for output band [r0, r1): input H-rows
+    [r0−t_top, r1+t_bot) with REAL interior halo rows and zero pads only
+    outside the image — so for the full band (0, h) this IS
+    _flatten_padded, and interior band edges read true neighbor pixels
+    (exactness; column wrap stays the kernel mask's job)."""
+    b, h, w, c = x.shape
+    lo = r0 - t_top
+    hi = r1 + t_bot
+    pt, pb = max(0, -lo), max(0, hi - h)
+    xs = x[:, max(lo, 0):min(hi, h)]
+    if pt or pb:
+        xs = jnp.pad(xs, ((0, 0), (pt, pb), (0, 0), (0, 0)))
+    return xs.reshape(b * (hi - lo) * w, c)
+
+
+def _banded_matmul(
+    x_list: Sequence[jax.Array],
+    w_stack: jax.Array,
+    taps_per_out,
+    h: int,
+    wd: int,
+    t_top: int,
+    t_bot: int,
+    couts: Sequence[int],
+    out_dtype,
+    *,
+    epilogue: Optional[Epilogue] = None,
+    ss: Optional[jax.Array] = None,
+    res: Optional[jax.Array] = None,
+) -> List[jax.Array]:
+    """Run _tapped_matmul over row bands of the (phase-)images in
+    x_list; returns per-output (b, h', wd, cout) arrays with the pad
+    rows sliced away and bands concatenated along H."""
+    b = x_list[0].shape[0]
+    rows_single = (t_top + h + t_bot) * wd
+    parts = []
+    for r0, r1 in _bands(h, rows_single, t_top, t_bot, wd):
+        hb = r1 - r0
+        rows = (t_top + hb + t_bot) * wd
+        outs = _tapped_matmul(
+            [_flatten_band(x, r0, r1, t_top, t_bot) for x in x_list],
+            w_stack, taps_per_out, rows, wd, t_top * wd, t_bot * wd,
+            couts, out_dtype,
+            epilogue=epilogue, ss=ss,
+            res_flat=(
+                None if res is None
+                else _flatten_band(res, r0, r1, t_top, t_bot)
+            ),
+        )
+        parts.append([
+            o.reshape(b, rows // wd, wd, o.shape[1])[:, t_top:t_top + hb]
+            for o in outs
+        ])
+    if len(parts) == 1:
+        return parts[0]
+    return [jnp.concatenate(ps, axis=1) for ps in zip(*parts)]
+
+
+def _flatten_band_zero(x: jax.Array, r0: int, r1: int, t_top: int,
+                       t_bot: int) -> jax.Array:
+    """Band flattening with ZERO halo rows (vs _flatten_band's real
+    ones): the cotangent side of banded wgrad. _wgrad_tap_kernel's
+    center slice spans every image in a multi-image block, interior
+    pad rows included — its correctness invariant is that g is zero
+    there, which real-data halos would break (each band's weight-grad
+    contribution is the sum over THAT band's output rows only)."""
+    b, h, w, c = x.shape
+    xs = x[:, r0:r1]
+    if t_top or t_bot:
+        xs = jnp.pad(xs, ((0, 0), (t_top, t_bot), (0, 0), (0, 0)))
+    return xs.reshape(b * (r1 - r0 + t_top + t_bot) * w, c)
+
+
+def _banded_wgrad(
+    x_list: Sequence[jax.Array],
+    g: jax.Array,
+    taps,
+    h: int,
+    wd: int,
+    t_top: int,
+    t_bot: int,
+    n_slots: int,
+) -> jax.Array:
+    """Per-band _tapped_wgrad calls summed in f32 — bands partition g's
+    center rows exactly, so the per-band weight grads add. x bands carry
+    real interior halos (the tap reads are data); g bands carry ZERO
+    halos (the kernel's pad-rows-are-zero invariant)."""
+    rows_single = (t_top + h + t_bot) * wd
+    gw = None
+    for r0, r1 in _bands(h, rows_single, t_top, t_bot, wd):
+        hb = r1 - r0
+        rows = (t_top + hb + t_bot) * wd
+        part = _tapped_wgrad(
+            [_flatten_band(x, r0, r1, t_top, t_bot) for x in x_list],
+            _flatten_band_zero(g, r0, r1, t_top, t_bot),
+            taps, rows, wd, t_top * wd, t_bot * wd, n_slots,
+        )
+        gw = part if gw is None else gw + part
+    return gw
+
+
 def _s1_taps(k: int, w: int):
     """Stride-1 tap set for odd k: (a_off, b_off) = (dy-p, dx-p)."""
     p = (k - 1) // 2
@@ -525,21 +811,21 @@ def _phases(x: jax.Array) -> List[jax.Array]:
     return [x[:, p::2, q::2, :] for p in (0, 1) for q in (0, 1)]
 
 
-def _conv_s1(x: jax.Array, w: jax.Array) -> jax.Array:
+def _conv_s1(x: jax.Array, w: jax.Array, epilogue=None, ss=None,
+             res=None) -> List[jax.Array]:
     b, h, wd, cin = x.shape
     k, cout = w.shape[0], w.shape[3]
     taps_ab = _s1_taps(k, wd)
     flat_offs = [a * wd + bo for a, bo, _ in taps_ab]
-    rows, t_top, lo, tail = _layout(h, wd, flat_offs)
+    _, t_top, _, tail = _layout(h, wd, flat_offs)
     taps = tuple(
         (0, a * wd + bo, bo, slot) for (a, bo, slot) in taps_ab
     )
-    (o_flat,) = _tapped_matmul(
-        [_flatten_padded(x, t_top, (rows // wd) - h - t_top)],
-        w.reshape(k * k, cin, cout).astype(x.dtype),
-        (taps,), rows, wd, lo, tail, [cout], x.dtype,
+    return _banded_matmul(
+        [x], w.reshape(k * k, cin, cout).astype(x.dtype), (taps,),
+        h, wd, t_top, tail // wd, [cout], x.dtype,
+        epilogue=epilogue, ss=ss, res=res,
     )
-    return o_flat.reshape(b, rows // wd, wd, cout)[:, t_top : t_top + h]
 
 
 def _dgrad_s1(g: jax.Array, w: jax.Array) -> jax.Array:
@@ -549,14 +835,12 @@ def _dgrad_s1(g: jax.Array, w: jax.Array) -> jax.Array:
     k, cin = w.shape[0], w.shape[2]
     taps_ab = [(-a, -bo, slot) for (a, bo, slot) in _s1_taps(k, wd)]
     flat_offs = [a * wd + bo for a, bo, _ in taps_ab]
-    rows, t_top, lo, tail = _layout(h, wd, flat_offs)
+    _, t_top, _, tail = _layout(h, wd, flat_offs)
     taps = tuple((0, a * wd + bo, bo, slot) for (a, bo, slot) in taps_ab)
     wt = w.reshape(k * k, cin, cout).transpose(0, 2, 1).astype(g.dtype)
-    (dx_flat,) = _tapped_matmul(
-        [_flatten_padded(g, t_top, (rows // wd) - h - t_top)],
-        wt, (taps,), rows, wd, lo, tail, [cin], g.dtype,
-    )
-    return dx_flat.reshape(b, rows // wd, wd, cin)[:, t_top : t_top + h]
+    return _banded_matmul(
+        [g], wt, (taps,), h, wd, t_top, tail // wd, [cin], g.dtype,
+    )[0]
 
 
 def _wgrad_s1(x: jax.Array, g: jax.Array, k: int) -> jax.Array:
@@ -564,34 +848,28 @@ def _wgrad_s1(x: jax.Array, g: jax.Array, k: int) -> jax.Array:
     cout = g.shape[3]
     taps_ab = _s1_taps(k, wd)
     flat_offs = [a * wd + bo for a, bo, _ in taps_ab]
-    rows, t_top, lo, tail = _layout(h, wd, flat_offs)
+    _, t_top, _, tail = _layout(h, wd, flat_offs)
     taps = tuple((0, a * wd + bo, bo, slot) for (a, bo, slot) in taps_ab)
-    t_bot = (rows // wd) - h - t_top
-    gw = _tapped_wgrad(
-        [_flatten_padded(x, t_top, t_bot)],
-        _flatten_padded(g, t_top, t_bot),
-        taps, rows, wd, lo, tail, k * k,
-    )
+    gw = _banded_wgrad([x], g, taps, h, wd, t_top, tail // wd, k * k)
     return gw.reshape(k, k, cin, cout)
 
 
-def _conv_s2_even(x: jax.Array, w: jax.Array) -> jax.Array:
+def _conv_s2_even(x: jax.Array, w: jax.Array, epilogue=None, ss=None,
+                  res=None) -> List[jax.Array]:
     b, h, wd, cin = x.shape
     k, cout = w.shape[0], w.shape[3]
     hh, wh = h // 2, wd // 2
     taps_pab = _s2_phase_taps(k)
     flat_offs = [a * wh + bo for _, a, bo, _ in taps_pab]
-    rows, t_top, lo, tail = _layout(hh, wh, flat_offs)
-    t_bot = (rows // wh) - hh - t_top
+    _, t_top, _, tail = _layout(hh, wh, flat_offs)
     taps = tuple(
         (ph, a * wh + bo, bo, slot) for (ph, a, bo, slot) in taps_pab
     )
-    flats = [_flatten_padded(p, t_top, t_bot) for p in _phases(x)]
-    (o_flat,) = _tapped_matmul(
-        flats, w.reshape(k * k, cin, cout).astype(x.dtype), (taps,),
-        rows, wh, lo, tail, [cout], x.dtype,
+    return _banded_matmul(
+        _phases(x), w.reshape(k * k, cin, cout).astype(x.dtype), (taps,),
+        hh, wh, t_top, tail // wh, [cout], x.dtype,
+        epilogue=epilogue, ss=ss, res=res,
     )
-    return o_flat.reshape(b, rows // wh, wh, cout)[:, t_top : t_top + hh]
 
 
 def _dgrad_s2_even(g, w, h: int, wd: int) -> jax.Array:
@@ -602,8 +880,7 @@ def _dgrad_s2_even(g, w, h: int, wd: int) -> jax.Array:
     hh, wh = h // 2, wd // 2
     inv = _s2_phase_taps(k, inverse=True)
     flat_offs = [a * wh + bo for _, a, bo, _ in inv]
-    rows, t_top, lo, tail = _layout(hh, wh, flat_offs)
-    t_bot = (rows // wh) - hh - t_top
+    _, t_top, _, tail = _layout(hh, wh, flat_offs)
     taps_per_out = tuple(
         tuple(
             (0, a * wh + bo, bo, slot)
@@ -612,15 +889,11 @@ def _dgrad_s2_even(g, w, h: int, wd: int) -> jax.Array:
         )
         for out_phase in range(4)
     )
-    g_flat = _flatten_padded(g, t_top, t_bot)
     wt = w.reshape(k * k, cin, cout).transpose(0, 2, 1).astype(g.dtype)
-    phase_outs = _tapped_matmul(
-        [g_flat], wt, taps_per_out, rows, wh, lo, tail, [cin] * 4, g.dtype,
+    ps = _banded_matmul(
+        [g], wt, taps_per_out, hh, wh, t_top, tail // wh,
+        [cin] * 4, g.dtype,
     )
-    ps = [
-        o.reshape(b, rows // wh, wh, cin)[:, t_top : t_top + hh]
-        for o in phase_outs
-    ]
     # Interleave phases back: columns then rows (pure XLA relayout).
     row0 = jnp.stack([ps[0], ps[1]], axis=3).reshape(b, hh, wd, cin)
     row1 = jnp.stack([ps[2], ps[3]], axis=3).reshape(b, hh, wd, cin)
@@ -633,15 +906,12 @@ def _wgrad_s2_even(x: jax.Array, g: jax.Array, k: int) -> jax.Array:
     hh, wh = h // 2, wd // 2
     taps_pab = _s2_phase_taps(k)
     flat_offs = [a * wh + bo for _, a, bo, _ in taps_pab]
-    rows, t_top, lo, tail = _layout(hh, wh, flat_offs)
-    t_bot = (rows // wh) - hh - t_top
+    _, t_top, _, tail = _layout(hh, wh, flat_offs)
     taps = tuple(
         (ph, a * wh + bo, bo, slot) for (ph, a, bo, slot) in taps_pab
     )
-    flats = [_flatten_padded(p, t_top, t_bot) for p in _phases(x)]
-    gw = _tapped_wgrad(
-        flats, _flatten_padded(g, t_top, t_bot), taps,
-        rows, wh, lo, tail, k * k,
+    gw = _banded_wgrad(
+        _phases(x), g, taps, hh, wh, t_top, tail // wh, k * k,
     )
     return gw.reshape(k, k, cin, cout)
 
@@ -652,27 +922,22 @@ def _wgrad_s2_even(x: jax.Array, g: jax.Array, k: int) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
-def _conv_1x1(x: jax.Array, w: jax.Array) -> jax.Array:
+def _conv_1x1(x: jax.Array, w: jax.Array, epilogue=None, ss=None,
+              res=None) -> List[jax.Array]:
     b, h, wd, cin = x.shape
     cout = w.shape[3]
-    (o_flat,) = _tapped_matmul(
-        [x.reshape(b * h * wd, cin)],
-        w.reshape(1, cin, cout).astype(x.dtype),
+    return _banded_matmul(
+        [x], w.reshape(1, cin, cout).astype(x.dtype),
         (((0, 0, 0, 0),),),
-        h * wd, wd, 0, 0, [cout], x.dtype,
+        h, wd, 0, 0, [cout], x.dtype,
+        epilogue=epilogue, ss=ss, res=res,
     )
-    return o_flat.reshape(b, h, wd, cout)
 
 
 def _wgrad_1x1(x: jax.Array, g: jax.Array) -> jax.Array:
     b, h, wd, cin = x.shape
     cout = g.shape[3]
-    gw = _tapped_wgrad(
-        [x.reshape(b * h * wd, cin)],
-        g.reshape(b * h * wd, cout),
-        ((0, 0, 0, 0),),
-        h * wd, wd, 0, 0, 1,
-    )
+    gw = _banded_wgrad([x], g, ((0, 0, 0, 0),), h, wd, 0, 0, 1)
     return gw.reshape(1, 1, cin, cout)
 
 
@@ -701,11 +966,11 @@ def _forward(x, w, stride):
     if k == 1:
         if stride == 2:
             x = x[:, ::2, ::2, :]
-        return _conv_1x1(x, w)
+        return _conv_1x1(x, w)[0]
     if stride == 1:
-        return _conv_s1(x, w)
+        return _conv_s1(x, w)[0]
     if x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0:
-        return _conv_s2_even(x, w)
+        return _conv_s2_even(x, w)[0]
     # Odd spatial dims at stride 2 (no zoo model hits this): stride-1 +
     # subsample at XLA's window phase. k-generic: for SAME padding with
     # odd k, pad_top(stride1) − pad_top(stride2) is 0 on odd dims and 1
@@ -714,7 +979,7 @@ def _forward(x, w, stride):
     # covers k ∈ {3, 5, 7} alike (closes the supports()/apply gap the
     # round-4 advisor flagged: supports() said yes for k>3 stride-2 but
     # this path raised on odd dims).
-    o = _conv_s1(x, w)
+    o = _conv_s1(x, w)[0]
     oy, ox = _s2_offsets(x.shape[1], x.shape[2], k)
     return o[:, oy::2, ox::2, :]
 
@@ -731,7 +996,7 @@ def _conv2d_bwd(stride, res, g):
     if k == 1:
         if stride == 2:
             xs = x[:, ::2, ::2, :]
-            dxs = _conv_1x1(g, w.transpose(0, 1, 3, 2))
+            dxs = _conv_1x1(g, w.transpose(0, 1, 3, 2))[0]
             dx = (
                 jnp.zeros((b, h, wd, cin), x.dtype)
                 .at[:, ::2, ::2, :]
@@ -739,7 +1004,7 @@ def _conv2d_bwd(stride, res, g):
             )
             gw = _wgrad_1x1(xs, g)
         else:
-            dx = _conv_1x1(g, w.transpose(0, 1, 3, 2))
+            dx = _conv_1x1(g, w.transpose(0, 1, 3, 2))[0]
             gw = _wgrad_1x1(x, g)
         return dx.astype(x.dtype), gw.astype(w.dtype)
     if stride == 2 and h % 2 == 0 and wd % 2 == 0:
@@ -760,6 +1025,113 @@ def _conv2d_bwd(stride, res, g):
 conv2d.defvjp(_conv2d_fwd, _conv2d_bwd)
 
 
+# ---------------------------------------------------------------------------
+# Fused conv epilogue (ISSUE 2 tentpole): relu?(conv·scale + shift
+# [+ residual]) in ONE kernel pass — the elementwise tail rides the f32
+# accumulator in VMEM instead of three-to-four extra HBM round-trips.
+# ---------------------------------------------------------------------------
+
+
+def _make_ss(scale: jax.Array, shift: jax.Array) -> jax.Array:
+    """(8, cout) f32 scale/shift block: row 0 scale, row 1 shift. Eight
+    rows keep the f32 sublane tile legal when cout-tiling blocks it."""
+    cout = scale.shape[0]
+    ss = jnp.zeros((8, cout), jnp.float32)
+    return (
+        ss.at[0].set(scale.astype(jnp.float32))
+        .at[1].set(shift.astype(jnp.float32))
+    )
+
+
+def _fused_forward(x, w, scale, shift, residual, stride, relu,
+                   want_preact):
+    """Dispatch conv2d_fused over the same geometry split as _forward;
+    returns (y, preact-or-None)."""
+    k = w.shape[0]
+    ep = Epilogue(
+        relu=relu,
+        residual=residual is not None,
+        emit_preact=want_preact,
+    )
+    ss = _make_ss(scale, shift)
+    if k == 1:
+        xs = x[:, ::2, ::2, :] if stride == 2 else x
+        outs = _conv_1x1(xs, w, epilogue=ep, ss=ss, res=residual)
+    elif stride == 1:
+        outs = _conv_s1(x, w, epilogue=ep, ss=ss, res=residual)
+    elif x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0:
+        outs = _conv_s2_even(x, w, epilogue=ep, ss=ss, res=residual)
+    else:
+        # Odd-dim stride-2 (outside every zoo model): conv in-kernel via
+        # the stride-1 fallback, epilogue in XLA — still one conv pass.
+        c = _forward(x, w, stride)
+        z = c.astype(jnp.float32) * scale.astype(jnp.float32)
+        z = z + shift.astype(jnp.float32)
+        if residual is not None:
+            z = z + residual.astype(jnp.float32)
+        if relu:
+            z = jnp.maximum(z, 0.0)
+        return z.astype(x.dtype), (c if want_preact else None)
+    if want_preact:
+        return outs[0], outs[1]
+    return outs[0], None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def conv2d_fused(
+    x: jax.Array,
+    w: jax.Array,
+    scale: jax.Array,
+    shift: jax.Array,
+    residual: Optional[jax.Array] = None,
+    stride: int = 1,
+    relu: bool = True,
+) -> jax.Array:
+    """``relu?(conv2d(x, w, stride)·scale + shift [+ residual])`` with
+    the whole elementwise tail fused into the conv kernel's output
+    block (≙ the reference CUDA kernels' fused bias+activation).
+
+    scale/shift are per-channel f32 — fold inference-mode BN as
+    ``scale = γ·rsqrt(var+ε)``, ``shift = β − mean·scale``. residual
+    (optional) must have the conv OUTPUT shape. The primal pays exactly
+    one HBM write; under `jax.grad` the fwd rule additionally saves the
+    raw conv output so the bwd rule can rebuild the ReLU mask and route
+    the conv cotangent through the existing `_conv2d_bwd` kernels, with
+    residual grads passing straight through."""
+    y, _ = _fused_forward(x, w, scale, shift, residual, stride, relu,
+                          False)
+    return y
+
+
+def _conv2d_fused_fwd(x, w, scale, shift, residual, stride, relu):
+    y, c = _fused_forward(x, w, scale, shift, residual, stride, relu,
+                          True)
+    return y, (x, w, scale, shift, residual, c)
+
+
+def _conv2d_fused_bwd(stride, relu, saved, g):
+    x, w, scale, shift, residual, c = saved
+    cf = c.astype(jnp.float32)
+    s = scale.astype(jnp.float32)
+    z = cf * s + shift.astype(jnp.float32)
+    if residual is not None:
+        z = z + residual.astype(jnp.float32)
+    gz = g.astype(jnp.float32)
+    if relu:
+        # where(z > 0): zero subgradient at z == 0, matching
+        # jax.nn.relu's custom JVP (the unfused reference composition).
+        gz = jnp.where(z > 0, gz, 0.0)
+    d_shift = jnp.sum(gz, axis=(0, 1, 2)).astype(shift.dtype)
+    d_scale = jnp.sum(gz * cf, axis=(0, 1, 2)).astype(scale.dtype)
+    g_c = (gz * s).astype(x.dtype)
+    dx, dw = _conv2d_bwd(stride, (x, w), g_c)
+    d_res = None if residual is None else gz.astype(residual.dtype)
+    return dx, dw, d_scale, d_shift, d_res
+
+
+conv2d_fused.defvjp(_conv2d_fused_fwd, _conv2d_fused_bwd)
+
+
 def supports(kernel: Tuple[int, int], strides: Tuple[int, int], padding: str) -> bool:
     """Shapes this kernel library covers; Conv2D falls back to XLA otherwise."""
     return (
@@ -767,4 +1139,26 @@ def supports(kernel: Tuple[int, int], strides: Tuple[int, int], padding: str) ->
         and kernel[0] == kernel[1]
         and strides in ((1, 1), (2, 2))
         and padding == "SAME"
+    )
+
+
+def prefer_xla_fallback(kernel: Tuple[int, int],
+                        strides: Tuple[int, int],
+                        in_shape: Tuple[int, ...]) -> bool:
+    """Honest compile-budget boundary for shapes `supports()` covers.
+
+    Row-band tiling (`_bands`) brings the 7×7-s2 stem at 224² down from
+    Mosaic-compile-pathological (>25 min single-unit) to a handful of
+    ≤`_MAX_ROWS_PER_IMG` kernel units, so nothing is rerouted by
+    default. `PCNN_PALLAS_STEM_XLA=1` is the documented stem→XLA hybrid
+    escape hatch (docs/kernel_authoring.md): if a jaxlib/Mosaic
+    regression re-opens the pathology, it reroutes ONLY the huge-input
+    k≥7 stem conv while every residual block keeps the fused Pallas
+    path."""
+    if not _STEM_XLA:
+        return False
+    return (
+        kernel[0] >= 7
+        and strides[0] == 2
+        and in_shape[1] * in_shape[2] >= 176 * 176
     )

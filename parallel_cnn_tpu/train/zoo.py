@@ -222,7 +222,16 @@ def make_train_step(
 
 
 def make_eval_step(model: Module) -> Callable:
-    """(params, model_state, x, y) -> correct-prediction count."""
+    """(params, model_state, x, y) -> correct-prediction count.
+
+    train=False is what routes conv_backend="pallas" ResNets through the
+    FUSED conv epilogues (nn.layers.ConvBNAct → ops.pallas_conv
+    .conv2d_fused): folded running-stats BN + shortcut add + ReLU run in
+    each conv kernel's output block, one HBM round-trip per layer. The
+    train step keeps the exact unfused composition — train-mode BN
+    statistics are reductions over the conv output, so a one-pass
+    fusion would change the batch-stat math (docs/kernel_authoring.md).
+    """
 
     @jax.jit
     def eval_step(params, model_state, x, y):

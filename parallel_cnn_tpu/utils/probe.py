@@ -1,0 +1,150 @@
+"""Shared TPU-backend probe: ONE health-check implementation for every
+driver-facing tool (bench.py's ``_resolve_platform`` wait loop and
+benches/watch.py's ``probe_once`` both import from here — round-5 shipped
+two hand-rolled copies whose behavior drifted).
+
+Contract (round-1 lesson, BENCH_r01): backend init through the axon
+relay can hang indefinitely when the tunnel is down, so health is ALWAYS
+probed in a subprocess with a hard timeout — the subprocess absorbs the
+hang, the caller never blocks past ``timeout``.
+
+PYTHONPATH handling (the round-5 ``PYTHONPATH=$PWD`` clobber trap): the
+probe subprocess must see the same import tree as the caller — including
+any sitecustomize hook that registers the axon plugin — so the repo root
+is APPENDED to the inherited PYTHONPATH, never assigned over it. A
+driver that exported its own PYTHONPATH keeps every entry.
+
+Retry schedule: ``wait_for_tpu`` ramps 15 s → ``RETRY_BACKOFF_CAP`` and
+then polls at the cap, which is also the watcher's default probe
+interval — bench and watcher see the same worst-case heal latency, so
+the bench no longer concedes to CPU on a schedule the watcher would
+have caught.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Callable, Optional, Tuple
+
+# Two lines: the configured platform list (the axon sitecustomize hook
+# sets e.g. "axon,cpu"), then the live default device's platform. The
+# LAST stdout line is the live platform (stray stdout noise lands
+# before it); the second-to-last is the configured list.
+_PROBE_SNIPPET = (
+    "import jax; print(jax.config.jax_platforms or '');"
+    " print(jax.devices()[0].platform)"
+)
+
+# Shared probe retry schedule: backoff ramps STEP·attempt up to CAP,
+# then polls at CAP. benches/watch.py's default --interval is CAP too.
+RETRY_BACKOFF_STEP = 15.0
+RETRY_BACKOFF_CAP = 60.0
+
+
+def probe_env() -> dict:
+    """Subprocess env with the repo root APPENDED to PYTHONPATH.
+
+    Append — never assign: replacing PYTHONPATH (round 5's
+    ``PYTHONPATH=$PWD``) silently dropped driver-supplied entries and
+    with them the sitecustomize hook that registers the axon TPU
+    plugin, so probes reported healthy CPU boxes as the platform truth.
+    """
+    env = dict(os.environ)
+    root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+    if root not in parts:
+        parts.append(root)
+    env["PYTHONPATH"] = os.pathsep.join(parts)
+    return env
+
+
+def probe_platform(
+    timeout: float = 120.0, runner=subprocess.run
+) -> Tuple[str, str]:
+    """One subprocess probe → (configured_platforms, live_platform).
+
+    ("", "") on nonzero exit, timeout, or exec failure — indistinguishable
+    from "down", which is the right default through a flaky relay.
+    """
+    try:
+        proc = runner(
+            [sys.executable, "-c", _PROBE_SNIPPET],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            env=probe_env(),
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        return "", ""
+    if getattr(proc, "returncode", 1) != 0:
+        return "", ""
+    lines = (proc.stdout or "").splitlines()
+    configured = lines[-2].strip() if len(lines) >= 2 else ""
+    live = lines[-1].strip() if lines else ""
+    return configured, live
+
+
+def probe_once(timeout: float = 120.0, runner=subprocess.run) -> bool:
+    """True iff a fresh process sees a non-CPU default jax backend.
+
+    A probe that *succeeds* but reports ``cpu`` (axon plugin loaded, no
+    TPU exposed) counts as down — that mode is exactly what produced the
+    CPU-fallback BENCH_r03/r04 artifacts.
+    """
+    _, live = probe_platform(timeout, runner)
+    return bool(live) and live != "cpu"
+
+
+def wait_for_tpu(
+    wait_budget: float,
+    timeout: float = 120.0,
+    probe: Callable[[float], Tuple[str, str]] = probe_platform,
+    sleep: Callable[[float], None] = time.sleep,
+    log: Optional[Callable[[str], None]] = None,
+    now: Callable[[], float] = time.perf_counter,
+) -> bool:
+    """Probe-with-backoff until a TPU shows up or the budget runs out.
+
+    Returns True the moment a probe reports a healthy non-CPU backend.
+    A clean probe that reports cpu with NO non-cpu platform configured
+    means there is probably no TPU plugin to wait FOR — concede after
+    TWO consecutive such probes instead of burning the whole wait
+    budget on a plain CPU box. (Two, not one: on a TPU VM whose plugin
+    failed transiently, jax_platforms is also unset and the first probe
+    can report cpu — the second probe after backoff catches the heal. A
+    flaky axon relay, by contrast, either hangs the probe or shows a
+    non-cpu entry in the platform list and keeps the full wait.)
+    """
+    t0 = now()
+    attempt = 0
+    clean_cpu_streak = 0
+    while True:
+        attempt += 1
+        configured, live = probe(timeout)
+        if live and live != "cpu":
+            return True
+        if live and not any(
+            p and p != "cpu" for p in configured.split(",")
+        ):
+            clean_cpu_streak += 1
+            if clean_cpu_streak >= 2:
+                return False  # plain CPU environment: nothing to wait for
+        else:
+            clean_cpu_streak = 0
+        remaining = wait_budget - (now() - t0)
+        if remaining <= 0:
+            return False
+        backoff = min(
+            RETRY_BACKOFF_STEP * attempt, RETRY_BACKOFF_CAP, remaining
+        )
+        if log is not None:
+            log(
+                f"backend probe {attempt} found no TPU; retrying in "
+                f"{backoff:.0f}s ({remaining:.0f}s of TPU wait budget left)"
+            )
+        sleep(backoff)

@@ -47,3 +47,8 @@ def pytest_configure(config):
         "markers",
         "chaos: deterministic fault-injection tests (resilience/chaos.py)",
     )
+    config.addinivalue_line(
+        "markers",
+        "pallas_epilogue: fused conv-epilogue kernel tests "
+        "(CPU interpret-mode safe; also the on-chip smoke selector)",
+    )

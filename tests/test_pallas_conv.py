@@ -202,3 +202,289 @@ def test_pick_bb_sublane_rule():
     # for the shapes every CIFAR model uses).
     bb = pallas_conv._pick_bb(512, 34, [64], [64] * 9, [64], 4, 4, 0)
     assert (bb * 34) % 8 == 0 and bb > 1
+
+
+# ---------------- round 6: fused epilogues + weight streaming ----------------
+
+
+def _fused_ref(x, wt, scale, shift, res, s, relu):
+    """The unfused XLA composition the kernel epilogue must reproduce:
+    conv → per-channel scale/shift (folded BN) → (+residual) → relu,
+    with the elementwise tail in f32 as the kernel computes it."""
+    z = _ref(x, wt, s).astype(jnp.float32) * scale + shift
+    if res is not None:
+        z = z + res.astype(jnp.float32)
+    if relu:
+        z = jnp.maximum(z, 0.0)
+    return z.astype(x.dtype)
+
+
+FUSED_CASES = [
+    # (b, h, w, cin, cout, k, s, residual)
+    (2, 8, 8, 4, 8, 3, 1, True),
+    (2, 8, 8, 4, 8, 3, 1, False),
+    (2, 8, 8, 4, 8, 3, 2, True),    # even dims: phase-decomposed stride 2
+    (2, 8, 8, 4, 8, 1, 1, True),    # 1×1 (the projection-shortcut shape)
+    (2, 8, 8, 4, 8, 1, 2, False),
+    (2, 12, 8, 3, 8, 7, 2, True),   # 7×7-s2 stem family
+    (2, 7, 9, 4, 8, 3, 2, True),    # odd dims: s1+subsample fallback path
+]
+
+
+def _fused_inputs(b, h, w, cin, cout, k, s, res, dtype=np.float32):
+    rng = np.random.default_rng(b + h + w + cin + cout + k + s)
+    x = jnp.asarray(rng.standard_normal((b, h, w, cin)).astype(dtype))
+    wt = jnp.asarray(rng.standard_normal((k, k, cin, cout)).astype(dtype) * 0.1)
+    scale = jnp.asarray(rng.uniform(0.5, 1.5, (cout,)).astype(np.float32))
+    # Shift around zero so relu masks a real fraction of outputs.
+    shift = jnp.asarray(rng.uniform(-0.5, 0.5, (cout,)).astype(np.float32))
+    ho, wo = -(-h // s), -(-w // s)
+    residual = (
+        jnp.asarray(rng.standard_normal((b, ho, wo, cout)).astype(dtype))
+        if res else None
+    )
+    return x, wt, scale, shift, residual
+
+
+@pytest.mark.pallas_epilogue
+@pytest.mark.parametrize("b,h,w,cin,cout,k,s,res", FUSED_CASES)
+@pytest.mark.parametrize("relu", [True, False])
+def test_conv2d_fused_matches_xla_composition(b, h, w, cin, cout, k, s, res,
+                                              relu):
+    x, wt, scale, shift, residual = _fused_inputs(b, h, w, cin, cout, k, s, res)
+    ref = _fused_ref(x, wt, scale, shift, residual, s, relu)
+    got = pallas_conv.conv2d_fused(x, wt, scale, shift, residual, s, relu)
+    assert got.shape == ref.shape and got.dtype == ref.dtype
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
+    if relu:
+        assert float(jnp.min(got)) >= 0.0
+        # The epilogue must actually be masking something, or the relu
+        # branch of the VJP is untested dead weight.
+        assert float(jnp.mean(got == 0.0)) > 0.0
+
+
+@pytest.mark.pallas_epilogue
+@pytest.mark.parametrize("b,h,w,cin,cout,k,s,res", FUSED_CASES)
+def test_conv2d_fused_grads_match_xla(b, h, w, cin, cout, k, s, res):
+    """custom_vjp through the fused epilogue (relu mask from the saved
+    preactivation, residual pass-through, d_scale/d_shift reductions)
+    vs XLA autodiff of the unfused composition — every differentiable
+    input: x, w, scale, shift, and the residual."""
+    x, wt, scale, shift, residual = _fused_inputs(b, h, w, cin, cout, k, s, res)
+
+    def loss_ref(x, wt, scale, shift, residual):
+        return jnp.sum(jnp.sin(_fused_ref(x, wt, scale, shift, residual,
+                                          s, True)))
+
+    def loss_fused(x, wt, scale, shift, residual):
+        return jnp.sum(jnp.sin(pallas_conv.conv2d_fused(
+            x, wt, scale, shift, residual, s, True
+        )))
+
+    argnums = (0, 1, 2, 3) + ((4,) if res else ())
+    g_ref = jax.grad(loss_ref, argnums=argnums)(x, wt, scale, shift, residual)
+    g_got = jax.grad(loss_fused, argnums=argnums)(x, wt, scale, shift, residual)
+    for a, b_ in zip(g_got, g_ref, strict=True):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-4)
+
+
+@pytest.mark.pallas_epilogue
+def test_conv2d_fused_bf16():
+    """bf16 activations/weights (the TPU zoo dtype) with f32 scale/shift:
+    f32 accumulate + f32 epilogue, output back in bf16, grads tracked
+    against the f32 XLA composition."""
+    b, h, w, cin, cout, k, s = 2, 8, 8, 4, 8, 3, 1
+    x, wt, scale, shift, residual = _fused_inputs(b, h, w, cin, cout, k, s,
+                                                  True)
+    xb, wb = x.astype(jnp.bfloat16), wt.astype(jnp.bfloat16)
+    rb = residual.astype(jnp.bfloat16)
+    out = pallas_conv.conv2d_fused(xb, wb, scale, shift, rb, s, True)
+    assert out.dtype == jnp.bfloat16
+    ref = _fused_ref(x, wt, scale, shift, residual, s, True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), atol=0.1
+    )
+
+    def loss(x, wt, res):
+        return jnp.sum(jnp.sin(pallas_conv.conv2d_fused(
+            x, wt, scale, shift, res, s, True
+        ).astype(jnp.float32)))
+
+    gx, gw, gr = jax.grad(loss, argnums=(0, 1, 2))(xb, wb, rb)
+    assert gx.dtype == jnp.bfloat16 and gw.dtype == jnp.bfloat16
+    g_ref = jax.grad(
+        lambda x, wt, res: jnp.sum(jnp.sin(_fused_ref(
+            x, wt, scale, shift, res, s, True
+        ))), argnums=(0, 1, 2),
+    )(x, wt, residual)
+    for got, ref_g, tol in zip((gx, gw, gr), g_ref, (0.05, 0.3, 0.05)):
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(ref_g), atol=tol
+        )
+
+
+@pytest.mark.pallas_epilogue
+def test_basicblock_fused_grads_match_xla():
+    """jax.grad through BOTH BasicBlock tails in eval mode — identity
+    (stride 1, matching channels) and projection (stride 2) — with the
+    pallas backend's fused single-kernel path vs the XLA composition.
+    Eval mode is exactly where the fused path engages (train keeps the
+    unfused batch-stat math)."""
+    from parallel_cnn_tpu.nn.resnet import BasicBlock
+
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.standard_normal((2, 8, 8, 8)).astype(np.float32) * 0.5)
+    for stride in (1, 2):  # identity path, then projection path
+        grads = {}
+        for backend in ("xla", "pallas"):
+            blk = BasicBlock(8, stride, backend)
+            params, state, _ = blk.init(jax.random.key(3), x.shape[1:])
+            if stride == 1:
+                assert "proj" not in params  # really the identity path
+
+            def loss(p, blk=blk, state=state):
+                out, _ = blk.apply(p, state, x, train=False)
+                return jnp.sum(jnp.sin(out))
+
+            grads[backend] = jax.grad(loss)(params)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(grads["xla"]),
+            jax.tree_util.tree_leaves(grads["pallas"]),
+            strict=True,
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4)
+
+
+def test_pick_bb_double_buffer_weight_accounting():
+    """The VMEM model must charge the weight block TWICE (the grid
+    pipeline double-buffers the weight DMA: tile j multiplies while
+    tile j+1 streams in). Pin the factor by sitting the budget on a
+    divisor boundary only the 2× charge crosses."""
+    n, rows, c, co = 16, 8, 64, 64
+    per_img = rows * (4 * (2 * c + c) + 4 * 2 * co + 4 * 2 * co)
+    # avail = budget − 2·w_bytes ≈ 15.5·per_img → want 15 → bb = 8.
+    # A single-buffer (1×) charge would leave avail ≈ 590·per_img and
+    # pick bb = 16; so would w_bytes = 0.
+    w_bytes = (pallas_conv._VMEM_BUDGET - 15 * per_img - per_img // 2) // 2
+    args = (n, rows, [c], [c], [co], 4, 4)
+    assert pallas_conv._pick_bb(*args, 0) == 16
+    assert pallas_conv._pick_bb(*args, w_bytes) == 8
+
+
+def test_bands_shapes():
+    """Row-band splitting (the 224² stem compile-pathology fix): bands
+    must tile [0, h) contiguously, stay under the per-unit row cap with
+    their halos, and collapse to one full band when under the cap."""
+    assert pallas_conv._bands(112, 112 * 115, 3, 3, 115) != [(0, 112)]
+    assert pallas_conv._bands(8, 8 * 10, 1, 1, 10) == [(0, 8)]
+    for h, w_col, t_top, t_bot, cap in [
+        (112, 115, 3, 3, 6144),   # the real 224²-input 7×7-s2 stem shape
+        (64, 32, 1, 1, 256),
+        (17, 8, 2, 2, 64),        # odd h, ragged final band
+    ]:
+        old = pallas_conv._MAX_ROWS_PER_IMG
+        pallas_conv._MAX_ROWS_PER_IMG = cap
+        try:
+            bands = pallas_conv._bands(h, h * w_col, t_top, t_bot, w_col)
+        finally:
+            pallas_conv._MAX_ROWS_PER_IMG = old
+        assert bands[0][0] == 0 and bands[-1][1] == h
+        for (a0, a1), (b0, b1) in zip(bands, bands[1:]):
+            assert a1 == b0 and a1 > a0
+        if len(bands) > 1:
+            hb = max(b1 - b0 for b0, b1 in bands)
+            assert (hb + t_top + t_bot) * w_col <= cap
+
+
+@pytest.mark.pallas_epilogue
+def test_banded_conv_matches_xla():
+    """Forced-small row cap: the banded kernels (interior halos of real
+    data, zero pads only outside the image, per-band wgrad partials
+    summed) must stay EXACT vs the single-unit path and XLA."""
+    old = pallas_conv._MAX_ROWS_PER_IMG
+    pallas_conv._MAX_ROWS_PER_IMG = 64
+    try:
+        for s in (1, 2):
+            rng = np.random.default_rng(11 + s)
+            x = jnp.asarray(rng.standard_normal((2, 16, 8, 4)).astype(np.float32))
+            wt = jnp.asarray(
+                rng.standard_normal((3, 3, 4, 8)).astype(np.float32) * 0.1
+            )
+            assert len(pallas_conv._bands(16, 16 * 8, 1, 1, 8)) > 1
+            np.testing.assert_allclose(
+                np.asarray(pallas_conv.conv2d(x, wt, s)),
+                np.asarray(_ref(x, wt, s)), atol=1e-5,
+            )
+            gx, gw = jax.grad(
+                lambda x, w: jnp.sum(jnp.sin(pallas_conv.conv2d(x, w, s))),
+                argnums=(0, 1),
+            )(x, wt)
+            gx_r, gw_r = jax.grad(
+                lambda x, w: jnp.sum(jnp.sin(_ref(x, w, s))), argnums=(0, 1)
+            )(x, wt)
+            np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_r),
+                                       atol=1e-5)
+            np.testing.assert_allclose(np.asarray(gw), np.asarray(gw_r),
+                                       atol=1e-4)
+    finally:
+        pallas_conv._MAX_ROWS_PER_IMG = old
+
+
+@pytest.mark.pallas_epilogue
+def test_cout_tiled_weight_streaming_matches_xla():
+    """Forced-small cout tile: the second grid dimension that streams
+    weight tiles (double-buffered by the pipeline) must not change
+    numerics — plain, fused, and grad paths."""
+    old = pallas_conv._COUT_TILE
+    pallas_conv._COUT_TILE = 128
+    try:
+        rng = np.random.default_rng(13)
+        x = jnp.asarray(rng.standard_normal((2, 8, 8, 8)).astype(np.float32))
+        wt = jnp.asarray(
+            rng.standard_normal((3, 3, 8, 256)).astype(np.float32) * 0.1
+        )
+        scale = jnp.asarray(rng.uniform(0.5, 1.5, (256,)).astype(np.float32))
+        shift = jnp.asarray(rng.uniform(-0.5, 0.5, (256,)).astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(pallas_conv.conv2d(x, wt, 1)),
+            np.asarray(_ref(x, wt, 1)), atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(pallas_conv.conv2d_fused(x, wt, scale, shift, None, 1)),
+            np.asarray(_fused_ref(x, wt, scale, shift, None, 1, True)),
+            atol=1e-5,
+        )
+        gx, gw = jax.grad(
+            lambda x, w: jnp.sum(jnp.sin(pallas_conv.conv2d_fused(
+                x, w, scale, shift, None, 1
+            ))), argnums=(0, 1),
+        )(x, wt)
+        gx_r, gw_r = jax.grad(
+            lambda x, w: jnp.sum(jnp.sin(_fused_ref(
+                x, w, scale, shift, None, 1, True
+            ))), argnums=(0, 1),
+        )(x, wt)
+        np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_r), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(gw), np.asarray(gw_r), atol=1e-4)
+    finally:
+        pallas_conv._COUT_TILE = old
+
+
+def test_prefer_xla_fallback_gate():
+    """The stem→XLA escape hatch is OFF by default (row-band tiling makes
+    the 224² stem compile); PCNN_PALLAS_STEM_XLA=1 reroutes ONLY the
+    huge-input 7×7-s2 family."""
+    import os
+
+    assert not pallas_conv.prefer_xla_fallback((7, 7), (2, 2), (8, 224, 224, 3))
+    old = pallas_conv._STEM_XLA
+    pallas_conv._STEM_XLA = True
+    try:
+        assert pallas_conv.prefer_xla_fallback((7, 7), (2, 2), (8, 224, 224, 3))
+        assert not pallas_conv.prefer_xla_fallback((7, 7), (2, 2), (8, 64, 64, 3))
+        assert not pallas_conv.prefer_xla_fallback((3, 3), (1, 1), (8, 224, 224, 3))
+    finally:
+        pallas_conv._STEM_XLA = old
+    assert os.environ.get("PCNN_PALLAS_STEM_XLA", "0") in ("", "0"), \
+        "test env leaked the stem escape hatch"
