@@ -338,15 +338,17 @@ def main() -> None:
     # MEDIAN of N same-session samples, with the min–max range reported
     # alongside. Each sample is a full _time_epochs measurement (warmed,
     # chained, RTT-corrected). N=5 on-chip (round 6: three samples left
-    # the range wider than the effect sizes being claimed); N=1 on the
-    # CPU fallback (no relay there, and the fallback should stay cheap).
+    # the range wider than the effect sizes being claimed); N=3 on the
+    # CPU fallback — cheap enough, and a single-sample headline made
+    # cross-round CPU comparisons meaningless (BENCH_r05's value_samples:1,
+    # see docs/bench_results.md "r05 vs_baseline" post-mortem).
     def median(xs):
         s = sorted(xs)
         n = len(s)
         return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
 
     n_samples = int(os.environ.get(
-        "PCNN_BENCH_SAMPLES", "5" if platform == "tpu" else "1"
+        "PCNN_BENCH_SAMPLES", "5" if platform == "tpu" else "3"
     ))
 
     def sample_ips(epoch_fn, n):

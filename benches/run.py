@@ -169,11 +169,13 @@ def _sync_time(thunk, repeats: int) -> float:
 def _n_samples() -> int:
     """Same-session sample count for throughput rows (bench.py protocol:
     ≥5 on-chip — three left the run-to-run range wider than the effect
-    sizes being claimed; 1 on the CPU fallback, which must stay cheap)."""
+    sizes being claimed; 3 on the CPU fallback, so the median+range stays
+    meaningful off-TPU too — a single sample made cross-round CPU
+    comparisons meaningless, see docs/bench_results.md)."""
     from parallel_cnn_tpu.utils.backend import canonical_platform
 
     return max(int(os.environ.get(
-        "PCNN_BENCH_SAMPLES", "5" if canonical_platform() == "tpu" else "1"
+        "PCNN_BENCH_SAMPLES", "5" if canonical_platform() == "tpu" else "3"
     )), 1)
 
 
@@ -400,6 +402,80 @@ def bench_dp_scaling(quick: bool) -> List[Row]:
     return rows
 
 
+def bench_comm(quick: bool) -> List[Row]:
+    """Gradient-collective ablation on the zoo accum×mesh leg: the SAME
+    explicit shard_map train step (cifar_cnn, accum_steps=2, all devices
+    on the data axis) with only the comm algorithm varied —
+
+      psum       monolithic lax.psum (XLA picks the algorithm),
+      ring       bucketed ring reduce-scatter/all-gather with microbatch
+                 comm/compute overlap (parallel/collectives.py),
+      ring_bf16  ring + bf16-on-the-wire (half the ICI payload bytes).
+
+    Because every variant shares one step body, the per-impl img/s rows
+    isolate the collective schedule; the baseline_src column carries each
+    variant's final-step loss delta vs psum, so the table double-checks
+    the ≤1e-5 (ring) / ≤1e-2 (bf16) parity contract while it measures.
+    On the 8-virtual-device CPU harness the "ICI" is shared-memory copies
+    — ranking is indicative, the TPU run is the real evidence."""
+    from parallel_cnn_tpu.config import CommConfig, MeshConfig
+    from parallel_cnn_tpu.data import synthetic
+    from parallel_cnn_tpu.nn import cifar
+    from parallel_cnn_tpu.train import zoo
+    from parallel_cnn_tpu.parallel import mesh as mesh_lib
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        return []
+    mesh = mesh_lib.make_mesh(MeshConfig(data=n_dev, model=1))
+    batch = (32 if quick else 64) * n_dev
+    imgs, labels = synthetic.make_image_dataset(batch, seed=3)
+    x, y = mesh_lib.shard_batch(mesh, (jnp.asarray(imgs), jnp.asarray(labels)))
+    model = cifar.cifar_cnn()
+    opt = zoo.make_optimizer(0.05)
+
+    variants = [
+        ("psum", CommConfig(impl="psum")),
+        ("ring", CommConfig(impl="ring")),
+        ("ring_bf16", CommConfig(impl="ring", wire_dtype="bfloat16")),
+    ]
+    rows: List[Row] = []
+    losses = {}
+    for name, comm in variants:
+        st = zoo.init_state(model, jax.random.key(0), cifar.IN_SHAPE, opt)
+        step = zoo.make_train_step(
+            model, opt, accum_steps=2, mesh=mesh, comm=comm
+        )
+        # Parity probe: 3 steps from identical init, BEFORE the timed
+        # region mutates state (the timed thunk chains its own states).
+        pst, ploss = st, None
+        for _ in range(3):
+            pst, ploss = step(pst, x, y)
+        losses[name] = float(ploss)
+
+        def thunk(carry, step=step, x=x, y=y):
+            # step donates its state arg, so a captured init state would
+            # be deleted after the first call — rebuild on each restart
+            # (thunk(None) runs before _sync_time's timed region).
+            s = carry[0] if carry is not None else zoo.init_state(
+                model, jax.random.key(0), cifar.IN_SHAPE, opt
+            )
+            return step(s, x, y)
+
+        ips, ips_range, n_s = _sampled_ips(
+            thunk, repeats=10 if quick else 30, images_per_call=batch
+        )
+        dloss = losses[name] - losses["psum"]
+        rows.append(
+            Row(f"comm_{name}_accum_mesh_train", ips, "images/sec",
+                baseline=None,
+                baseline_src=(f"{n_dev}dev b{batch} accum2; "
+                              f"loss-psum={dloss:+.2e}"),
+                value_range=ips_range, value_samples=n_s).finish()
+        )
+    return rows
+
+
 def bench_northstar(quick: bool) -> List[Row]:
     """BASELINE.json's north-star metric: epochs-to-98% test accuracy for
     the MNIST LeNet (throughput mode, shuffled minibatch SGD), plus the
@@ -605,7 +681,7 @@ def main(argv=None) -> int:
         "--suite",
         default="all",
         choices=["all", "lenet", "phases", "dp", "zoo", "parity", "ops",
-                 "northstar"],
+                 "comm", "northstar"],
     )
     args = ap.parse_args(argv)
 
@@ -623,6 +699,7 @@ def main(argv=None) -> int:
         "ops": bench_ops_paths,
         "dp": bench_dp_scaling,
         "zoo": bench_zoo,
+        "comm": bench_comm,
         "northstar": bench_northstar,
     }
     picked = suites.values() if args.suite == "all" else [suites[args.suite]]

@@ -17,6 +17,7 @@ import os
 from typing import List, Optional
 
 from parallel_cnn_tpu.config import (
+    CommConfig,
     Config,
     DataConfig,
     MeshConfig,
@@ -102,6 +103,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "zoo models: filter/channel GSPMD sharding "
                         "(parallel/zoo_sharding.py) composed with "
                         "--mesh-data DP on the 2-D mesh")
+    p.add_argument("--comm-impl", default=None,
+                   choices=["psum", "ring"],
+                   help="mesh runs: gradient-collective algorithm "
+                        "(parallel/collectives.py) — monolithic psum, or "
+                        "bucketed ring reduce-scatter/all-gather over the "
+                        "data axis. Default: PCNN_COMM_IMPL, else the "
+                        "historical implicit psum/GSPMD path")
+    p.add_argument("--comm-bucket-mb", type=float, default=None, metavar="MB",
+                   help="ring collective bucket size in MiB "
+                        "(PCNN_COMM_BUCKET_BYTES; default 4)")
+    p.add_argument("--comm-wire-dtype", default=None,
+                   choices=["float32", "bfloat16"],
+                   help="collective payload dtype on the wire; bfloat16 "
+                        "halves ICI bytes, accumulation stays f32 "
+                        "(PCNN_COMM_WIRE_DTYPE)")
     p.add_argument("--checkpoint-dir", default=None,
                    help="save ckpt_<epoch>.npz per epoch; --resume restarts "
                         "from the latest")
@@ -186,8 +202,22 @@ def config_from_args(args: argparse.Namespace) -> Config:
         check_every_steps=args.sentinel_every,
         pallas_fallback=not args.no_pallas_fallback,
     )
+    # Env first (PCNN_COMM_*), explicit flags override field-by-field;
+    # all-defaults → comm=None, the historical implicit-collective path.
+    comm = CommConfig.from_env()
+    if (args.comm_impl is not None or args.comm_bucket_mb is not None
+            or args.comm_wire_dtype is not None):
+        base = comm or CommConfig()
+        comm = dataclasses.replace(
+            base,
+            impl=args.comm_impl or base.impl,
+            bucket_bytes=(int(args.comm_bucket_mb * 1024 * 1024)
+                          if args.comm_bucket_mb is not None
+                          else base.bucket_bytes),
+            wire_dtype=args.comm_wire_dtype or base.wire_dtype,
+        )
     return Config(data=data, train=train, mesh=mesh,
-                  resilience=resilience, model=args.model)
+                  resilience=resilience, comm=comm, model=args.model)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -370,6 +400,17 @@ def _run_zoo(args: argparse.Namespace, cfg: Config) -> int:
         )
         print(f"mesh: {dict(mesh.shape)}")
 
+    if cfg.comm is not None and mesh is None:
+        raise SystemExit(
+            "--comm-impl/PCNN_COMM_* select the explicit mesh collective "
+            "path; add --mesh-data N (or --mesh-model)"
+        )
+    if cfg.comm is not None and model_axis:
+        raise SystemExit(
+            "--comm-impl is data-parallel only; model-axis sharding stays "
+            "on the GSPMD path (drop --mesh-model or --comm-impl)"
+        )
+
     metrics = MetricsLogger(path=args.metrics) if args.metrics else None
     # batch-size sentinel: zoo default is minibatch 128; an explicit 1 is
     # a config error (per-sample SGD is the lenet_ref parity mode).
@@ -395,6 +436,7 @@ def _run_zoo(args: argparse.Namespace, cfg: Config) -> int:
             accum_steps=args.accum_steps,
             mesh=mesh,
             model_axis=model_axis,
+            comm=cfg.comm,
             seed=args.seed,
             eval_data=(ev_imgs, ev_labels),
             checkpoint_dir=args.checkpoint_dir,

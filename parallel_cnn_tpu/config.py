@@ -13,6 +13,7 @@ knobs the reference couldn't have (mesh shape, batching, dtype policy).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Optional
 
 
@@ -148,6 +149,65 @@ class MeshConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class CommConfig:
+    """Gradient-collective policy (parallel/collectives.py).
+
+    The default (no CommConfig at all — Config.comm is None) keeps the
+    historical behavior: one monolithic psum/GSPMD all-reduce per step.
+    Constructing one opts the mesh trainers into the explicit-comm path,
+    where the reduce algorithm, bucket granularity, and wire precision
+    become knobs (docs/collectives.md has the cost model)."""
+
+    # "psum"  — monolithic lax.psum, XLA picks the algorithm (baseline);
+    # "ring"  — bucketed ring reduce-scatter + all-gather (lax.ppermute),
+    #           2(n−1)/n wire payload and an explicit schedule XLA can
+    #           overlap with microbatch compute.
+    impl: str = "psum"
+    # Bucket payload budget for impl="ring" (bytes). Small buckets pay the
+    # per-hop latency many times; huge buckets lose overlap granularity.
+    bucket_bytes: int = 4 * 1024 * 1024
+    # Payload dtype on the wire: "float32" (exact) or "bfloat16" (half the
+    # ICI bytes; accumulation stays f32 master precision).
+    wire_dtype: str = "float32"
+    # impl="ring" × grad accumulation: reduce-scatter each microbatch's
+    # buckets as soon as its grads are final (overlapping the reduce with
+    # the next microbatch's compute), one all-gather at the end. False
+    # reduces once after the full accumulation loop.
+    overlap: bool = True
+
+    def __post_init__(self):
+        if self.impl not in ("psum", "ring"):
+            raise ValueError(f"unknown comm impl {self.impl!r}")
+        if self.bucket_bytes <= 0:
+            raise ValueError(
+                f"bucket_bytes must be > 0, got {self.bucket_bytes}"
+            )
+        if self.wire_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"unknown wire dtype {self.wire_dtype!r} "
+                "(float32 or bfloat16)"
+            )
+
+    @staticmethod
+    def from_env() -> Optional["CommConfig"]:
+        """CommConfig from PCNN_COMM_IMPL / PCNN_COMM_BUCKET_BYTES /
+        PCNN_COMM_WIRE_DTYPE / PCNN_COMM_OVERLAP, or None when none of
+        them is set (→ the historical implicit-psum path)."""
+        impl = os.environ.get("PCNN_COMM_IMPL")
+        bucket = os.environ.get("PCNN_COMM_BUCKET_BYTES")
+        wire = os.environ.get("PCNN_COMM_WIRE_DTYPE")
+        overlap = os.environ.get("PCNN_COMM_OVERLAP")
+        if impl is None and bucket is None and wire is None and overlap is None:
+            return None
+        return CommConfig(
+            impl=impl or "psum",
+            bucket_bytes=int(bucket) if bucket else 4 * 1024 * 1024,
+            wire_dtype=wire or "float32",
+            overlap=overlap != "0" if overlap is not None else True,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
@@ -155,6 +215,9 @@ class Config:
     resilience: ResilienceConfig = dataclasses.field(
         default_factory=ResilienceConfig
     )
+    # None = historical implicit collectives (monolithic psum / GSPMD);
+    # a CommConfig opts mesh training into parallel/collectives.py.
+    comm: Optional[CommConfig] = None
     model: str = "lenet_ref"
 
     def replace(self, **kw) -> "Config":

@@ -29,69 +29,51 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from parallel_cnn_tpu.ops import reference as ops
 from parallel_cnn_tpu.ops.activations import apply_grad
-from parallel_cnn_tpu.parallel.mesh import DATA_AXIS
+from parallel_cnn_tpu.parallel import collectives
+from parallel_cnn_tpu.parallel.mesh import DATA_AXIS, shard_map
 
 Params = ops.Params
 
 
 def _local_grads(params: Params, x: jax.Array, y: jax.Array,
                  compute_dtype=None, ops_path: str = "reference"):
-    """Per-device shard: reference grads summed over the local batch.
+    """Per-device shard: reference grads summed over the local batch —
+    shared with the single-device minibatch step (one numerics definition
+    for both modes; the bf16 and Pallas routing lives there too)."""
+    # Deferred import: train/__init__ pulls in trainer, which imports this
+    # package — a top-level import here would run during that partial init.
+    from parallel_cnn_tpu.train.step import local_grad_sums
 
-    compute_dtype="bfloat16" runs the shard's forward/backward in bf16
-    (params stay f32 master weights outside; the cast here is shard-local)
-    and returns f32 sums — so the cross-device psum and the update are
-    always f32, the standard DP×bf16 recipe (same as train/step.py
-    batched_step). ops_path="pallas" computes the shard's grads in the
-    fused Mosaic megakernel (ops/pallas.py) — kernels are batch-local, so
-    DP composition is just this call inside shard_map.
-    """
-    cdt = jnp.dtype(compute_dtype or "float32")
-    cparams = jax.tree_util.tree_map(lambda p: p.astype(cdt), params)
-    cx = x.astype(cdt)
-    if ops_path == "pallas":
-        if cdt != jnp.float32:
-            raise ValueError(
-                "ops_path='pallas' computes f32 (the fused kernel casts its "
-                "inputs); a bf16 request would be silently mislabeled"
-            )
-        from parallel_cnn_tpu.ops import pallas as pk
-
-        n_local = x.shape[0]
-        err_mean, mean_grads = pk.fused_value_and_ref_grads(cparams, cx, y)
-        sum_grads = jax.tree_util.tree_map(
-            lambda g: g.astype(jnp.float32) * n_local, mean_grads
-        )
-        return err_mean.astype(jnp.float32) * n_local, sum_grads
-    errs, grads = jax.vmap(ops.value_and_ref_grads, in_axes=(None, 0, 0))(
-        cparams, cx, y
-    )
-    sum_grads = jax.tree_util.tree_map(
-        lambda g: jnp.sum(g.astype(jnp.float32), axis=0), grads
-    )
-    return jnp.sum(errs.astype(jnp.float32)), sum_grads
+    return local_grad_sums(params, x, y, compute_dtype, ops_path)
 
 
 def _dp_update(params: Params, x: jax.Array, y: jax.Array, dt: float,
-               global_batch: int, compute_dtype=None, ops_path: str = "reference"):
+               global_batch: int, compute_dtype=None,
+               ops_path: str = "reference", comm=None, axis_size: int = 1):
     """One DP update on a device's shard (runs inside shard_map): local
-    reference grads → ONE psum over ICI (≙ the MPI backend's 16 root-only
-    reduces per SAMPLE, MPI/layer.h) → mean → `p += dt·g`. psum also
-    broadcasts, so every device ends the step with identical params."""
+    reference grads → ONE allreduce over ICI (≙ the MPI backend's 16
+    root-only reduces per SAMPLE, MPI/layer.h) → mean → `p += dt·g`. The
+    allreduce broadcasts too, so every device ends the step with identical
+    params. ``comm`` selects the algorithm (collectives.tree_all_reduce):
+    None/psum keeps the monolithic psum, impl="ring" goes bucketed ring
+    RS+AG, optionally bf16-on-the-wire."""
     err_sum, grad_sum = _local_grads(params, x, y, compute_dtype, ops_path)
-    err_sum = jax.lax.psum(err_sum, DATA_AXIS)
-    grad_sum = jax.lax.psum(grad_sum, DATA_AXIS)
+    err_sum = jax.lax.psum(err_sum, DATA_AXIS)  # scalar: bucketing is noise
+    grad_sum = collectives.tree_all_reduce(grad_sum, DATA_AXIS, axis_size, comm)
     mean_grads = jax.tree_util.tree_map(lambda g: g / global_batch, grad_sum)
     return apply_grad(params, mean_grads, dt), err_sum / global_batch
 
 
 def make_dp_step(mesh: Mesh, dt: float, global_batch: int,
-                 compute_dtype: str | None = None, ops_path: str = "reference"):
+                 compute_dtype: str | None = None, ops_path: str = "reference",
+                 comm=None):
     """Build the jitted DP train step for a fixed global batch size.
 
     Returns step(params, x, y) -> (params, mean_err) where x:(B,28,28) and
     y:(B,) are sharded over the data axis and params are replicated
-    (f32 master weights regardless of compute_dtype).
+    (f32 master weights regardless of compute_dtype). ``comm`` (a
+    config.CommConfig) picks the gradient-allreduce algorithm; None is the
+    historical monolithic psum.
     """
 
     n_data = mesh.shape[DATA_AXIS]
@@ -103,17 +85,20 @@ def make_dp_step(mesh: Mesh, dt: float, global_batch: int,
             raise ValueError(
                 f"batch {x.shape[0] * n_data} != global_batch {global_batch}"
             )
-        return _dp_update(params, x, y, dt, global_batch, compute_dtype, ops_path)
+        return _dp_update(params, x, y, dt, global_batch, compute_dtype,
+                          ops_path, comm, n_data)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=(P(), P()),
-        # pallas_call's out_shape carries no varying-mesh-axes info, so the
-        # vma checker cannot see through it; the differential tests pin the
-        # semantics instead.
-        check_vma=(ops_path != "pallas"),
+        # pallas_call's out_shape carries no varying-mesh-axes info, and
+        # ring ppermute outputs are per-device values, so the replication
+        # checker cannot see through either; the differential tests pin
+        # the semantics instead.
+        check_vma=(ops_path != "pallas"
+                   and (comm is None or comm.impl != "ring")),
     )
     return jax.jit(sharded, donate_argnums=(0,))
 
@@ -130,7 +115,7 @@ def make_dp_eval(mesh: Mesh):
         pred = jax.vmap(ops.predict, in_axes=(None, 0))(params, x)
         return jax.lax.psum(jnp.sum((pred != y) & mask), DATA_AXIS)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
@@ -162,7 +147,7 @@ def make_dp_epoch(mesh: Mesh, dt: float, global_batch: int):
         params, errs = jax.lax.scan(body, params, (images, labels))
         return params, jnp.mean(errs)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(P(), P(None, DATA_AXIS), P(None, DATA_AXIS)),

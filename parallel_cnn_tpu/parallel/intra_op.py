@@ -43,7 +43,8 @@ from parallel_cnn_tpu.ops.activations import (
     sigmoid,
     sigmoid_grad_from_preact,
 )
-from parallel_cnn_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from parallel_cnn_tpu.parallel import collectives
+from parallel_cnn_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, shard_map
 
 Params = ops.Params
 
@@ -145,17 +146,21 @@ def _sample_grads(params: Params, x: jax.Array, y: jax.Array):
 
 
 def make_2d_step(mesh: Mesh, dt: float, global_batch: int,
-                 compute_dtype: str | None = None):
+                 compute_dtype: str | None = None, comm=None):
     """Hybrid DP×model-parallel train step over the full 2-D mesh.
 
     params follow PARAM_SPECS; x:(B,28,28) / y:(B,) are sharded over the
     data axis and replicated over model. One jitted program; grads are
-    psum-reduced over ``data`` (DP) while activations/grads inside each
-    sample are decomposed over ``model`` (intra-op).
+    allreduced over ``data`` (DP) while activations/grads inside each
+    sample are decomposed over ``model`` (intra-op). ``comm`` (a
+    config.CommConfig) picks the data-axis grad-reduce algorithm
+    (collectives.tree_all_reduce); None is the historical monolithic
+    psum. The model-axis activation collectives always stay psum — they
+    are small and latency-bound, exactly where a ring loses.
 
     compute_dtype="bfloat16": the per-sample forward/backward (including
     the model-axis activation psum) runs bf16; grads are cast back to f32
-    BEFORE the data-axis psum, and params stay f32 master weights — the
+    BEFORE the data-axis reduce, and params stay f32 master weights — the
     same mixed-precision recipe as train/step.py batched_step, composed
     with both mesh axes.
     """
@@ -173,20 +178,21 @@ def make_2d_step(mesh: Mesh, dt: float, global_batch: int,
             cparams, x.astype(cdt), y
         )
         err_sum = lax.psum(jnp.sum(errs.astype(jnp.float32)), DATA_AXIS)
-        grad_sum = jax.tree_util.tree_map(
-            lambda g: lax.psum(
-                jnp.sum(g.astype(jnp.float32), axis=0), DATA_AXIS
-            ),
-            grads,
+        local_sums = jax.tree_util.tree_map(
+            lambda g: jnp.sum(g.astype(jnp.float32), axis=0), grads
+        )
+        grad_sum = collectives.tree_all_reduce(
+            local_sums, DATA_AXIS, n_data, comm
         )
         mean_grads = jax.tree_util.tree_map(lambda g: g / global_batch, grad_sum)
         return apply_grad(params, mean_grads, dt), err_sum / global_batch
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(PARAM_SPECS, P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=(PARAM_SPECS, P()),
+        check_vma=(comm is None or comm.impl != "ring"),
     )
     return jax.jit(sharded, donate_argnums=(0,))
 
@@ -198,7 +204,7 @@ def make_2d_forward(mesh: Mesh):
         out = jax.vmap(lambda s: _forward_local(params, s)[-1])(x)
         return out
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(PARAM_SPECS, P(DATA_AXIS)),

@@ -30,6 +30,42 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
 
+def _resolve_shard_map():
+    """Locate shard_map and its replication-checker kwarg across jax
+    versions: jax>=0.6 exposes `jax.shard_map(..., check_vma=)`, older
+    releases `jax.experimental.shard_map.shard_map(..., check_rep=)`."""
+    import inspect
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm  # type: ignore
+    try:
+        params = inspect.signature(sm).parameters
+    except (TypeError, ValueError):  # C-accelerated / wrapped callables
+        params = {}
+    for kw in ("check_vma", "check_rep"):
+        if kw in params:
+            return sm, kw
+    return sm, None
+
+
+_SHARD_MAP, _CHECK_KW = _resolve_shard_map()
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """Version-portable shard_map — the single entry point every module in
+    parallel/ and train/ uses (never `jax.shard_map` directly).
+
+    ``check_vma=False`` disables the replication checker under whichever
+    spelling the installed jax uses (`check_vma` / `check_rep`); needed by
+    the Pallas shard bodies (pallas_call's out_shape carries no
+    varying-mesh-axes info) and the ring collectives (ppermute outputs are
+    per-device values the checker cannot prove replicated, even though
+    reduce-scatter + all-gather leaves every device identical)."""
+    kw = {_CHECK_KW: check_vma} if _CHECK_KW is not None else {}
+    return _SHARD_MAP(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
 def make_mesh(cfg: Optional[MeshConfig] = None, devices: Optional[Sequence] = None) -> Mesh:
     """Build the (data, model) mesh from config.
 

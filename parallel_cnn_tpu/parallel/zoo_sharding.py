@@ -47,6 +47,19 @@ def leaf_spec(leaf: Any, model_size: int) -> P:
     return P()
 
 
+def constrain_replicated(tree: Any, mesh: Mesh):
+    """Pin every leaf fully replicated (traceable — call inside jit).
+
+    The pure-DP zoo step uses this on params so GSPMD lands the gradient
+    all-reduce over the data axis even under future multi-axis meshes;
+    the explicit-comm step (train/zoo.py, parallel/collectives.py) gets
+    the same property by construction from its shard_map in_specs."""
+    repl = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(
+        lambda leaf: jax.lax.with_sharding_constraint(leaf, repl), tree
+    )
+
+
 def constrain(tree: Any, mesh: Mesh):
     """Apply the leaf rule as GSPMD sharding constraints (traceable —
     call inside jit). The jitted train step is the only placement path:

@@ -29,6 +29,48 @@ from parallel_cnn_tpu.ops.activations import apply_grad
 Params = ops.Params
 
 
+def local_grad_sums(params: Params, x: jax.Array, y: jax.Array,
+                    compute_dtype=None, ops_path: str = "reference"):
+    """Reference-contract grads SUMMED over a batch: (err_sum, grad_sums).
+
+    The shared grad engine for minibatch training — `batched_step` divides
+    by the local batch, the data-parallel shard bodies
+    (parallel/data_parallel.py) psum the sums over ICI and divide by the
+    GLOBAL batch, so both modes share one numerics definition.
+
+    compute_dtype="bfloat16" runs the forward/backward in bf16 (params
+    stay f32 master weights in the caller; the cast here is local) and
+    returns f32 sums — cross-device collectives and updates are always
+    f32. ops_path="pallas" computes the grads in the fused Mosaic
+    megakernel (ops/pallas.py); the kernel is batch-local, so every
+    composition is just this call.
+    """
+    cdt = jnp.dtype(compute_dtype or "float32")
+    cparams = jax.tree_util.tree_map(lambda p: p.astype(cdt), params)
+    cx = x.astype(cdt)
+    if ops_path == "pallas":
+        if cdt != jnp.float32:
+            raise ValueError(
+                "ops_path='pallas' computes f32 (the fused kernel casts its "
+                "inputs); a bf16 request would be silently mislabeled"
+            )
+        from parallel_cnn_tpu.ops import pallas as pk
+
+        n_local = x.shape[0]
+        err_mean, mean_grads = pk.fused_value_and_ref_grads(cparams, cx, y)
+        sum_grads = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32) * n_local, mean_grads
+        )
+        return err_mean.astype(jnp.float32) * n_local, sum_grads
+    errs, grads = jax.vmap(ops.value_and_ref_grads, in_axes=(None, 0, 0))(
+        cparams, cx, y
+    )
+    sum_grads = jax.tree_util.tree_map(
+        lambda g: jnp.sum(g.astype(jnp.float32), axis=0), grads
+    )
+    return jnp.sum(errs.astype(jnp.float32)), sum_grads
+
+
 def sgd_step(params: Params, x: jax.Array, y: jax.Array, dt: float) -> Tuple[Params, jax.Array]:
     """One per-sample step: forward → hand-written backward → p += dt·g
     (≙ one iteration of the loop at Sequential/Main.cpp:157-171)."""
@@ -75,17 +117,10 @@ def batched_step(
     reference numerics (SURVEY.md §2.1) — the strict-parity per-sample
     path stays f32-only.
     """
-    # astype to the same dtype is a traced no-op, so one code path covers
-    # both modes; grads always come back f32 for the master-weight update.
-    cdt = jnp.dtype(compute_dtype or "float32")
-    cparams = jax.tree_util.tree_map(lambda p: p.astype(cdt), params)
-    errs, grads = jax.vmap(ops.value_and_ref_grads, in_axes=(None, 0, 0))(
-        cparams, x.astype(cdt), y
-    )
-    grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
-    errs = errs.astype(jnp.float32)
-    mean_grads = jax.tree_util.tree_map(lambda g: jnp.mean(g, axis=0), grads)
-    return apply_grad(params, mean_grads, dt), jnp.mean(errs)
+    err_sum, grad_sums = local_grad_sums(params, x, y, compute_dtype)
+    n = x.shape[0]
+    mean_grads = jax.tree_util.tree_map(lambda g: g / n, grad_sums)
+    return apply_grad(params, mean_grads, dt), err_sum / n
 
 
 @functools.partial(

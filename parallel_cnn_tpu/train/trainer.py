@@ -209,15 +209,18 @@ def learn(
             def build_mesh_step(dt_):
                 return intra_op.make_2d_step(
                     mesh, dt=dt_, global_batch=tc.batch_size,
-                    compute_dtype=tc.dtype,
+                    compute_dtype=tc.dtype, comm=cfg.comm,
                 )
         else:
             params = mesh_lib.replicate(mesh, params)
 
             def build_mesh_step(dt_):
+                # cfg.comm routes the gradient allreduce through
+                # parallel/collectives.py (psum vs bucketed ring ± bf16
+                # wire); None keeps the historical monolithic psum.
                 step = data_parallel.make_dp_step(
                     mesh, dt=dt_, global_batch=tc.batch_size,
-                    compute_dtype=tc.dtype, ops_path=tc.ops,
+                    compute_dtype=tc.dtype, ops_path=tc.ops, comm=cfg.comm,
                 )
                 if tc.ops == "pallas" and res.pallas_fallback:
                     step = with_fallback(
@@ -225,6 +228,7 @@ def learn(
                         data_parallel.make_dp_step(
                             mesh, dt=dt_, global_batch=tc.batch_size,
                             compute_dtype=tc.dtype, ops_path="reference",
+                            comm=cfg.comm,
                         ),
                         name="pallas DP step",
                     )

@@ -104,6 +104,7 @@ def make_train_step(
     mesh: Optional[Mesh] = None,
     augment: Optional[Callable] = None,
     model_axis: bool = False,
+    comm=None,
 ) -> Callable:
     """Build the jitted train step: (state, x, y) -> (state, loss), or
     (state, x, y, key) -> (state, loss) when `augment` is given.
@@ -122,9 +123,27 @@ def make_train_step(
     rule (parallel/zoo_sharding.py) — hybrid DP×model-parallel training
     on the 2-D mesh, the zoo-scale extension of the reference's per-kernel
     intra-op decomposition (MPI/layer.h:162-201). Requires ``mesh``.
+
+    ``comm`` (a config.CommConfig) switches DP to the EXPLICIT collective
+    path (_make_comm_step): the step becomes a shard_map over the data
+    axis and the gradient reduce goes through parallel/collectives.py —
+    monolithic psum, or bucketed ring reduce-scatter/all-gather with
+    optional bf16 wire and microbatch comm/compute overlap. Requires
+    ``mesh``; mutually exclusive with model_axis (the explicit path is
+    data-axis only — GSPMD keeps owning the 2-D decomposition).
     """
     if model_axis and mesh is None:
         raise ValueError("model_axis=True requires a mesh")
+    if comm is not None:
+        if mesh is None:
+            raise ValueError("comm (explicit collectives) requires a mesh")
+        if model_axis:
+            raise ValueError(
+                "comm is the explicit data-parallel collective path; "
+                "model_axis sharding stays on the GSPMD path (comm=None)"
+            )
+        return _make_comm_step(model, optimizer, accum_steps, mesh,
+                               augment, comm)
 
     def loss_fn(params, model_state, x, y):
         logits, new_state = model.apply(params, model_state, x, train=True)
@@ -198,12 +217,10 @@ def make_train_step(
             else:
                 # Pin params replicated so the gradient all-reduce lands
                 # over the data axis even under future multi-axis meshes.
-                repl = NamedSharding(mesh, P())
+                from parallel_cnn_tpu.parallel import zoo_sharding
+
                 state = ZooState(
-                    jax.tree_util.tree_map(
-                        lambda p: jax.lax.with_sharding_constraint(p, repl),
-                        state.params,
-                    ),
+                    zoo_sharding.constrain_replicated(state.params, mesh),
                     state.model_state,
                     state.opt_state,
                 )
@@ -217,6 +234,163 @@ def make_train_step(
         )
         params = optax.apply_updates(state.params, updates)
         return ZooState(params, model_state, opt_state), loss
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def _make_comm_step(
+    model: Module,
+    optimizer: optax.GradientTransformation,
+    accum_steps: int,
+    mesh: Mesh,
+    augment: Optional[Callable],
+    comm,
+) -> Callable:
+    """Explicit-collective DP train step (comm= on make_train_step).
+
+    Where the default zoo path hands GSPMD one jitted program and lets
+    XLA insert the gradient all-reduce, this path IS the shard_map: each
+    device runs the microbatch loop on its batch shard and the gradient
+    reduce is written out explicitly via parallel/collectives.py —
+    psum (baseline) or bucketed ring reduce-scatter/all-gather, optional
+    bf16-on-the-wire.
+
+    Overlap schedule (comm.impl="ring", comm.overlap, accum_steps > 1):
+    microbatch i's grad buckets are reduce-scattered the moment its
+    backward finishes, and the running sum is kept SHARDED (1/n of the
+    grad memory); one all-gather after the last microbatch rematerializes
+    full grads for the optimizer. The inter-microbatch
+    `optimization_barrier` deliberately EXCLUDES the shard accumulators —
+    serializing them would chain every collective behind the next
+    microbatch's input and un-overlap the schedule; the barrier keeps its
+    activation-memory role through (bx, lsum, model_state) only.
+
+    Semantics deltas vs the GSPMD path, both deliberate and documented
+    (docs/collectives.md): BatchNorm batch statistics are computed per
+    data shard (the classic large-scale DP recipe; GSPMD's are global),
+    with the running stats pmean'd so checkpoints stay replicated; the
+    epoch loss is likewise the pmean of shard losses. psum and ring run
+    the SAME body, so an impl ablation isolates the collective algorithm.
+    """
+    from parallel_cnn_tpu.parallel import collectives
+    from parallel_cnn_tpu.parallel.mesh import shard_map
+
+    n_data = mesh.shape[DATA_AXIS]
+    wire = collectives.wire_dtype_arg(comm)
+    use_ring = comm.impl == "ring"
+    overlap = use_ring and comm.overlap and accum_steps > 1
+
+    def loss_fn(params, model_state, x, y):
+        logits, new_state = model.apply(params, model_state, x, train=True)
+        return cross_entropy(logits, y), new_state
+
+    def shard_body(state: ZooState, x, y, key_data=None):
+        params, model_state = state.params, state.model_state
+        if augment is not None:
+            # Typed keys don't cross the shard_map boundary portably; the
+            # raw key data does. Fold in the device index so each shard
+            # draws its own augmentation stream (the GSPMD path gets the
+            # same effect from batch-position-dependent crop draws).
+            key = jax.random.wrap_key_data(key_data)
+            key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
+            x = augment(key, x)
+        if x.shape[0] % accum_steps:
+            raise ValueError(
+                f"per-device batch {x.shape[0]} must be a multiple of "
+                f"accum_steps {accum_steps} (no silent sample dropping)"
+            )
+        mb = x.shape[0] // accum_steps
+        lsum = jnp.float32(0.0)
+        gsum = None       # unreduced accumulator (non-overlap schedules)
+        shard_acc = None  # reduce-scattered accumulator (overlap schedule)
+        plan = None
+        for i in range(accum_steps):
+            bx = x[i * mb : (i + 1) * mb]
+            by = y[i * mb : (i + 1) * mb]
+            if i:
+                # Same microbatch sequencing as microbatch_grads — but
+                # shard_acc stays OUT of the barrier: the in-flight
+                # reduce-scatters must remain schedulable alongside this
+                # microbatch's compute (the whole point of overlap).
+                if gsum is None:
+                    bx, lsum, model_state = jax.lax.optimization_barrier(
+                        (bx, lsum, model_state)
+                    )
+                else:
+                    bx, gsum, lsum, model_state = jax.lax.optimization_barrier(
+                        (bx, gsum, lsum, model_state)
+                    )
+            (loss, model_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, model_state, bx, by)
+            lsum = lsum + loss
+            if overlap:
+                if plan is None:
+                    plan = collectives.plan_buckets(
+                        grads, comm.bucket_bytes, shards=n_data
+                    )
+                shards = collectives.reduce_scatter_buckets(
+                    collectives.flatten_buckets(grads, plan),
+                    DATA_AXIS, n_data, wire,
+                )
+                shard_acc = (
+                    shards
+                    if shard_acc is None
+                    else [a + b for a, b in zip(shard_acc, shards)]
+                )
+            else:
+                gsum = (
+                    grads
+                    if gsum is None
+                    else jax.tree_util.tree_map(jnp.add, gsum, grads)
+                )
+        if overlap:
+            buckets = collectives.all_gather_buckets(
+                shard_acc, DATA_AXIS, n_data, wire
+            )
+            grads = collectives.unflatten_buckets(buckets, plan)
+        else:
+            grads = collectives.tree_all_reduce(gsum, DATA_AXIS, n_data, comm)
+        # Each microbatch loss/grad is a LOCAL-shard mean; the collective
+        # summed over n_data devices, so the global mean divides by both.
+        grads = jax.tree_util.tree_map(
+            lambda g: g / (accum_steps * n_data), grads
+        )
+        loss = jax.lax.pmean(lsum / accum_steps, DATA_AXIS)
+        model_state = jax.lax.pmean(model_state, DATA_AXIS)
+        updates, opt_state = optimizer.update(grads, state.opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return ZooState(params, model_state, opt_state), loss
+
+    specs = dict(
+        mesh=mesh,
+        out_specs=(P(), P()),
+        # ppermute outputs are per-device values the replication checker
+        # cannot prove replicated (they are — RS+AG leaves every device
+        # with identical sums; tests/test_collectives.py pins it).
+        check_vma=not use_ring,
+    )
+    if augment is not None:
+        sharded = shard_map(
+            shard_body, in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P()),
+            **specs,
+        )
+
+        def step(state: ZooState, x, y, key=None):
+            if key is None:
+                raise ValueError(
+                    "this train step was built with `augment`; call it as "
+                    "step(state, x, y, key) with a fresh PRNG key per step"
+                )
+            return sharded(state, x, y, jax.random.key_data(key))
+
+    else:
+        sharded = shard_map(
+            shard_body, in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)), **specs
+        )
+
+        def step(state: ZooState, x, y, key=None):
+            return sharded(state, x, y)
 
     return jax.jit(step, donate_argnums=(0,))
 
@@ -305,6 +479,7 @@ def train(
     accum_steps: int = 1,
     mesh: Optional[Mesh] = None,
     model_axis: bool = False,
+    comm=None,
     seed: int = 0,
     verbose: bool = True,
     eval_data: Optional[Tuple[Any, Any]] = None,
@@ -361,6 +536,12 @@ def train(
       of params/optimizer/BN stats over the mesh's ``model`` axis
       (parallel/zoo_sharding.py) composed with DP — hybrid 2-D training.
 
+    - ``comm`` (a config.CommConfig; requires ``mesh``, excludes
+      ``model_axis``): route DP through the explicit collective path
+      (parallel/collectives.py) — psum or bucketed ring RS/AG with
+      optional bf16 wire and microbatch overlap; see _make_comm_step for
+      the (documented) BatchNorm batch-stat semantics delta vs GSPMD.
+
     - ``resilience`` (a config.ResilienceConfig): health-sentinel policy
       over the epoch loss and params — and, when ``check_every_steps``
       is set, every N optimizer steps (each check is a host sync; the
@@ -399,7 +580,8 @@ def train(
             return aug_lib.random_crop_flip(key, x, pad=augment_pad)
 
     step = make_train_step(
-        model, optimizer, accum_steps, mesh, aug_fn, model_axis=model_axis
+        model, optimizer, accum_steps, mesh, aug_fn, model_axis=model_axis,
+        comm=comm,
     )
     ev_step = make_eval_step(model) if eval_data is not None else None
 

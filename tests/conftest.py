@@ -41,6 +41,24 @@ def rng():
     return np.random.default_rng(42)
 
 
+@pytest.fixture(scope="session")
+def host_devices():
+    """The suite-wide 8-device virtual CPU platform, as a fixture.
+
+    Multi-device tests (collectives, sharding) depend on THIS rather than
+    mutating XLA_FLAGS/JAX_PLATFORMS per test: the device count is baked
+    into the process at first backend init (the module-top setup above),
+    so per-test env mutation cannot work and would only desynchronize the
+    suite. Skips — rather than fails — if the platform somehow came up
+    short, so the suite stays runnable under a restricted backend."""
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip(
+            f"needs the 8-device virtual host platform, got {len(devices)}"
+        )
+    return devices
+
+
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long end-to-end tests")
     config.addinivalue_line(
@@ -51,4 +69,9 @@ def pytest_configure(config):
         "markers",
         "pallas_epilogue: fused conv-epilogue kernel tests "
         "(CPU interpret-mode safe; also the on-chip smoke selector)",
+    )
+    config.addinivalue_line(
+        "markers",
+        "comm: gradient-collective tests (parallel/collectives.py — "
+        "bucketizer round-trip, ring vs psum parity, bf16 wire)",
     )
