@@ -650,6 +650,130 @@ def bench_zoo(quick: bool) -> List[Row]:
     return rows
 
 
+def bench_serve(quick: bool) -> List[Row]:
+    """Inference-serving ablation (serve/): the SAME engine + weights
+    under three serving disciplines —
+
+      batch1      sequential predict(x[None]) per request — the no-
+                  batching strawman every serving system is measured
+                  against,
+      dynamic     one replica behind the dynamic batcher, closed-loop
+                  clients (batching emerges from concurrency),
+      2replicas   dynamic batching + a second engine replica (only when
+                  the platform exposes ≥2 devices; on the 8-virtual-CPU
+                  harness the replicas share silicon, so the row shows
+                  pipeline overlap, not 2× silicon).
+
+    Throughput rows are wall-clock request rates (host queueing included
+    — that IS the serving number, unlike the chained-dispatch training
+    rows), median of N with range. Each dynamic row carries client p50/
+    p99 and the shed rate in the baseline_src column; at this sub-
+    capacity offered load the shed rate must be 0. The parity row
+    re-proves the padding contract in-suite: a padded-bucket engine
+    prediction must be bit-identical to the same-bucket jit forward."""
+    from parallel_cnn_tpu.config import ServeConfig
+    from parallel_cnn_tpu.serve import get, loadgen, serve_stack
+
+    handle = get("cifar_cnn")
+    max_batch = 8 if quick else 16
+    n_req = 96 if quick else 256
+    cfg0 = ServeConfig(model="cifar_cnn", max_batch=max_batch,
+                       max_wait_ms=2.0, queue_depth=max(n_req, 256))
+    samples = loadgen.make_samples(64, handle.in_shape, seed=0)
+    rows: List[Row] = []
+
+    # -- parity row first: no point timing a wrong answer ---------------
+    pool, batcher = serve_stack(handle, cfg0, start=False)
+    e0 = pool.engines[0]
+    n, b = 3, 4
+    got = e0.predict(samples[:n])
+    padded = np.concatenate(
+        [samples[:n], np.zeros((b - n, *handle.in_shape), np.float32)]
+    )
+    ref = np.asarray(jax.jit(
+        lambda v: handle.forward(e0._params, e0._state, v)
+    )(jnp.asarray(padded)))[:n]
+    if not np.array_equal(got, ref):
+        raise RuntimeError(
+            "serve parity violated: padded-bucket engine prediction is not "
+            f"bit-identical to the same-bucket jit forward "
+            f"(max |d| {float(np.max(np.abs(got - ref))):.2e})"
+        )
+    rows.append(
+        Row("serve_parity_padded_bucket", 1.0, "bitwise-equal",
+            baseline_src=f"n={n} padded into bucket {b}, cifar_cnn").finish()
+    )
+    batcher.close()
+
+    def timed(run_once) -> tuple:
+        """Median-of-N wall-clock req/s (+ the last run's report)."""
+        rps, last = [], None
+        for _ in range(_n_samples()):
+            t0 = time.perf_counter()
+            last = run_once()
+            rps.append(round(n_req / (time.perf_counter() - t0), 1))
+        return _median(rps), [min(rps), max(rps)], len(rps), last
+
+    # -- batch=1 sequential strawman ------------------------------------
+    e0.predict(samples[:1])  # warm bucket 1
+
+    def run_batch1():
+        for i in range(n_req):
+            e0.predict(samples[i % len(samples)][None])
+        return None
+
+    v, rng_, n_s, _ = timed(run_batch1)
+    rows.append(
+        Row("serve_batch1_sequential", v, "req/sec",
+            baseline_src="no batching: one predict per request",
+            value_range=rng_, value_samples=n_s).finish()
+    )
+    batch1_rps = v
+
+    # -- dynamic batching (1 replica, then 2 if the platform has them) --
+    n_dev = len(jax.devices())
+    variants = [("serve_dynamic_batch", 1)]
+    if n_dev >= 2:
+        variants.append(("serve_dynamic_2replicas", 2))
+    else:
+        print("[bench_serve] 2-replica row skipped (1 device visible; "
+              "run under the 8-virtual-device CPU harness or on a multi-"
+              "chip platform)", flush=True)
+    for name, n_rep in variants:
+        cfg = ServeConfig(model="cifar_cnn", max_batch=max_batch,
+                          max_wait_ms=2.0, queue_depth=max(n_req, 256),
+                          n_replicas=n_rep)
+        pool, batcher = serve_stack(handle, cfg)
+        try:
+            def run_closed(batcher=batcher):
+                return loadgen.run(
+                    batcher, pattern="closed", n_requests=n_req,
+                    concurrency=16, samples=samples, seed=0,
+                )
+
+            v, rng_, n_s, rep = timed(run_closed)
+            lat = rep.latency.summary(scale=1e3)
+            rows.append(
+                Row(name, v, "req/sec",
+                    baseline=batch1_rps, baseline_src=(
+                        f"vs batch1; p50 {lat['p50']:.1f} ms, "
+                        f"p99 {lat['p99']:.1f} ms, "
+                        f"shed {rep.shed_rate:.3f}, "
+                        f"occupancy {batcher.stats.mean_occupancy():.2f}"
+                    ),
+                    value_range=rng_, value_samples=n_s).finish()
+            )
+            if rep.shed_rate != 0.0:
+                raise RuntimeError(
+                    f"{name}: shed rate {rep.shed_rate:.3f} at sub-capacity "
+                    "offered load (closed loop must never shed with "
+                    "queue_depth >= n_requests)"
+                )
+        finally:
+            batcher.close()
+    return rows
+
+
 def render_md(rows: List[Row]) -> str:
     lines = [
         "| benchmark | value | unit | reference baseline | speedup | samples |",
@@ -681,7 +805,7 @@ def main(argv=None) -> int:
         "--suite",
         default="all",
         choices=["all", "lenet", "phases", "dp", "zoo", "parity", "ops",
-                 "comm", "northstar"],
+                 "comm", "northstar", "serve"],
     )
     args = ap.parse_args(argv)
 
@@ -701,6 +825,7 @@ def main(argv=None) -> int:
         "zoo": bench_zoo,
         "comm": bench_comm,
         "northstar": bench_northstar,
+        "serve": bench_serve,
     }
     picked = suites.values() if args.suite == "all" else [suites[args.suite]]
 

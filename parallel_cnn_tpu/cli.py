@@ -22,6 +22,7 @@ from parallel_cnn_tpu.config import (
     DataConfig,
     MeshConfig,
     ResilienceConfig,
+    ServeConfig,
     TrainConfig,
 )
 
@@ -220,8 +221,188 @@ def config_from_args(args: argparse.Namespace) -> Config:
                   resilience=resilience, comm=comm, model=args.model)
 
 
+def build_serve_parser(cmd: str) -> argparse.ArgumentParser:
+    """Shared flag surface for the `serve` and `loadgen` subcommands.
+
+    Defaults come from ServeConfig.from_env() (the PCNN_SERVE_* table in
+    the README), flags override field-by-field — same env-then-flags
+    layering as the comm config."""
+    sc = ServeConfig.from_env()
+    p = argparse.ArgumentParser(
+        prog=f"parallel_cnn_tpu {cmd}",
+        description=(
+            "inference serving (serve/): checkpoint → AOT-compiled, "
+            "shape-bucketed, dynamically batched predict"
+            if cmd == "serve"
+            else "drive the serving stack with seeded traffic and report "
+                 "latency percentiles / shed rate"
+        ),
+    )
+    p.add_argument("--model", default=sc.model,
+                   choices=["lenet_ref", "cifar_cnn", "resnet18", "resnet34",
+                            "resnet50", "vgg16"],
+                   help="registry name (serve/registry.py); must match the "
+                        "checkpoint's model [PCNN_SERVE_MODEL]")
+    p.add_argument("--checkpoint", default=sc.checkpoint,
+                   help="restore params (+ BN stats) from this .npz; both "
+                        "lenet params-only and zoo full-state checkpoints "
+                        "load (optimizer state ignored) "
+                        "[PCNN_SERVE_CHECKPOINT]")
+    p.add_argument("--conv-backend", default=sc.conv_backend,
+                   choices=["xla", "pallas"],
+                   help="resnet/vgg only: conv kernel library; pallas takes "
+                        "the fused eval epilogues [PCNN_SERVE_CONV_BACKEND]")
+    p.add_argument("--max-batch", type=int, default=sc.max_batch,
+                   help="top shape bucket (power of two) "
+                        "[PCNN_SERVE_MAX_BATCH]")
+    p.add_argument("--max-wait-ms", type=float, default=sc.max_wait_ms,
+                   help="batch coalescing window [PCNN_SERVE_MAX_WAIT_MS]")
+    p.add_argument("--queue-depth", type=int, default=sc.queue_depth,
+                   help="bounded request queue; full → typed Overloaded "
+                        "shed [PCNN_SERVE_QUEUE_DEPTH]")
+    p.add_argument("--replicas", type=int, default=sc.n_replicas,
+                   help="engine replicas pinned round-robin across local "
+                        "devices [PCNN_SERVE_REPLICAS]")
+    p.add_argument("--deadline-ms", type=float, default=sc.deadline_ms,
+                   help="per-request deadline budget (0 = none) "
+                        "[PCNN_SERVE_DEADLINE_MS]")
+    p.add_argument("--no-precompile", action="store_true",
+                   help="compile buckets lazily on first use instead of at "
+                        "startup [PCNN_SERVE_PRECOMPILE=0]")
+    p.add_argument("--requests", type=int,
+                   default=64 if cmd == "serve" else 512,
+                   help="traffic volume to drive through the stack")
+    p.add_argument("--pattern", default="closed",
+                   choices=["closed", "open"],
+                   help="arrival pattern (serve/loadgen.py): closed-loop "
+                        "concurrency or open-loop Poisson")
+    p.add_argument("--concurrency", type=int, default=8,
+                   help="closed loop: synchronous client count")
+    p.add_argument("--rate", type=float, default=500.0,
+                   help="open loop: offered Poisson rate, req/s")
+    p.add_argument("--seed", type=int, default=0,
+                   help="payload + arrival-process seed (replayable)")
+    p.add_argument("--json", default=None, metavar="PATH",
+                   help="write the report/telemetry snapshot as JSON")
+    return p
+
+
+def _serve_config_from_args(args: argparse.Namespace) -> ServeConfig:
+    return ServeConfig(
+        model=args.model,
+        checkpoint=args.checkpoint,
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        queue_depth=args.queue_depth,
+        n_replicas=args.replicas,
+        deadline_ms=args.deadline_ms,
+        conv_backend=args.conv_backend,
+        precompile=not args.no_precompile,
+    )
+
+
+def _run_serve(cmd: str, argv: List[str]) -> int:
+    """`serve` and `loadgen` subcommands.
+
+    `serve` is the operator's view: restore the checkpoint, AOT-compile
+    the bucket ladder (printing the compile-cache table), prove the
+    padding/parity contract on one padded bucket, drive a short smoke of
+    traffic, and print the telemetry snapshot. `loadgen` is the
+    benchmarker's view: the same stack under a chosen arrival pattern,
+    reporting client-side p50/p90/p99 and shed rate (optionally as JSON).
+    No network listener on purpose: this environment has no ingress, so
+    the serving surface is in-process (batcher.submit) and the transport
+    layer stays a documented TODO (docs/serving.md).
+    """
+    args = build_serve_parser(cmd).parse_args(argv)
+    cfg = _serve_config_from_args(args)
+
+    import jax
+
+    if os.environ.get("PCNN_JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["PCNN_JAX_PLATFORMS"])
+    import json as json_mod
+    import time
+
+    import numpy as np
+
+    from parallel_cnn_tpu.serve import get, loadgen, serve_stack
+
+    handle = get(cfg.model, conv_backend=cfg.conv_backend)
+    t0 = time.perf_counter()
+    pool, batcher = serve_stack(handle, cfg)
+    startup = time.perf_counter() - t0
+    src = cfg.checkpoint or "fresh init (no --checkpoint)"
+    print(f"[serve] model={cfg.model} params from {src}")
+    print(f"[serve] replicas={cfg.n_replicas} on "
+          f"{[str(e.device) for e in pool.engines]}")
+    if cfg.precompile:
+        buckets = pool.engines[0].stats.compile_seconds
+        table = ", ".join(f"b{b}: {s * 1e3:.0f} ms"
+                          for b, s in sorted(buckets.items()))
+        print(f"[serve] AOT bucket ladder compiled in {startup:.2f}s "
+              f"({table})")
+
+    with batcher:
+        if cmd == "serve":
+            # Padding parity probe (the dryrun leg's cheap twin): padded
+            # bucket prediction must be bit-identical to the same-bucket
+            # jit forward.
+            import jax.numpy as jnp
+
+            e0 = pool.engines[0]
+            b = min(4, cfg.max_batch)
+            n = max(b - 1, 1)
+            xs = loadgen.make_samples(n, handle.in_shape, seed=args.seed)
+            got = e0.predict(xs)
+            pad = np.zeros((b - n, *handle.in_shape), np.float32)
+            ref = np.asarray(jax.jit(
+                lambda v: handle.forward(e0._params, e0._state, v)
+            )(jnp.concatenate([jnp.asarray(xs), jnp.asarray(pad)])))[:n]
+            parity = "bit-identical" if np.array_equal(got, ref) else (
+                f"MISMATCH (max |Δ| {float(np.max(np.abs(got - ref))):.2e})"
+            )
+            print(f"[serve] padded-bucket parity (n={n}→b{b}): {parity}")
+
+        report = loadgen.run(
+            batcher,
+            pattern=args.pattern,
+            n_requests=args.requests,
+            concurrency=args.concurrency,
+            rate=args.rate,
+            deadline_ms=args.deadline_ms or None,
+            seed=args.seed,
+        )
+        print(f"[{cmd}] {args.pattern}-loop: "
+              f"{report.completed}/{report.requests} ok, "
+              f"{report.throughput:.1f} req/s, "
+              f"shed rate {report.shed_rate:.3f}")
+        lat = report.latency.summary(scale=1e3)
+        if lat.get("count"):
+            print(f"[{cmd}] latency p50 {lat['p50']:.2f} ms, "
+                  f"p90 {lat['p90']:.2f} ms, p99 {lat['p99']:.2f} ms")
+        print(batcher.stats.render())
+        if args.json:
+            out = {"config": dataclasses.asdict(cfg),
+                   "report": report.to_dict(),
+                   "telemetry": batcher.stats.snapshot()}
+            with open(args.json, "w") as f:
+                json_mod.dump(out, f, indent=2)
+            print(f"[{cmd}] report written to {args.json}")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
-    args = build_parser().parse_args(argv)
+    import sys
+
+    raw = list(sys.argv[1:] if argv is None else argv)
+    # Subcommand dispatch rides in front of the historical flat trainer
+    # CLI: `python -m parallel_cnn_tpu serve|loadgen …` routes to the
+    # serving stack, anything else keeps the original flag surface
+    # unchanged (no retrofit of subparsers onto existing automation).
+    if raw and raw[0] in ("serve", "loadgen"):
+        return _run_serve(raw[0], raw[1:])
+    args = build_parser().parse_args(raw)
     cfg = config_from_args(args)
 
     # Surface the data pipeline's INFO-level evidence (e.g. the real-MNIST

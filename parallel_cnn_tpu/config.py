@@ -208,6 +208,76 @@ class CommConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Inference-serving policy (serve/ subsystem — the layer that turns
+    training checkpoints into a request-serving surface; docs/serving.md
+    has the queueing model and the bucket/padding cost math)."""
+
+    # Registry name (serve/registry.py): lenet_ref, cifar_cnn,
+    # resnet18/34/50, vgg16.
+    model: str = "cifar_cnn"
+    # Checkpoint to restore params (+ BN stats) from; None serves
+    # seed-initialized weights (bench/smoke mode).
+    checkpoint: Optional[str] = None
+    # Largest batch the engine compiles; must be a power of two — it is
+    # the top of the shape-bucket ladder 1, 2, 4, …, max_batch, and a
+    # non-pow2 cap would silently never be used.
+    max_batch: int = 64
+    # Batcher coalescing window: a batch dispatches at max_batch OR when
+    # this many ms have passed since its first request, whichever first.
+    max_wait_ms: float = 2.0
+    # Bounded request queue; a full queue sheds new requests with the
+    # typed serve.Overloaded error (backpressure, not OOM).
+    queue_depth: int = 256
+    # Engine replicas pinned round-robin across local devices.
+    n_replicas: int = 1
+    # Default per-request deadline budget (ms); 0 = no deadline. Requests
+    # already past their deadline at dispatch time are dropped with
+    # serve.DeadlineExceeded instead of wasting a device slot.
+    deadline_ms: float = 0.0
+    # Conv kernel library for zoo models (resnet/vgg): "xla" or "pallas"
+    # (fused eval epilogues, ops/pallas_conv.py).
+    conv_backend: str = "xla"
+    # AOT-compile every bucket at startup so steady-state requests never
+    # trigger a trace; False compiles lazily on first use per bucket.
+    precompile: bool = True
+
+    def __post_init__(self):
+        if self.max_batch < 1 or (self.max_batch & (self.max_batch - 1)):
+            raise ValueError(
+                f"max_batch must be a power of two >= 1, got {self.max_batch}"
+            )
+        if self.max_wait_ms < 0 or self.deadline_ms < 0:
+            raise ValueError("max_wait_ms/deadline_ms must be >= 0")
+        if self.queue_depth < 1:
+            raise ValueError(f"queue_depth must be >= 1, got {self.queue_depth}")
+        if self.n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {self.n_replicas}")
+        if self.conv_backend not in ("xla", "pallas"):
+            raise ValueError(f"unknown conv backend {self.conv_backend!r}")
+
+    @staticmethod
+    def from_env() -> "ServeConfig":
+        """ServeConfig with PCNN_SERVE_* environment overrides applied
+        over the defaults (README has the full table). Unlike
+        CommConfig.from_env there is no None sentinel — serving has no
+        historical implicit path to preserve, so the env vars simply
+        re-default the config the CLI flags then override."""
+        e = os.environ.get
+        return ServeConfig(
+            model=e("PCNN_SERVE_MODEL", "cifar_cnn"),
+            checkpoint=e("PCNN_SERVE_CHECKPOINT") or None,
+            max_batch=int(e("PCNN_SERVE_MAX_BATCH", "64")),
+            max_wait_ms=float(e("PCNN_SERVE_MAX_WAIT_MS", "2.0")),
+            queue_depth=int(e("PCNN_SERVE_QUEUE_DEPTH", "256")),
+            n_replicas=int(e("PCNN_SERVE_REPLICAS", "1")),
+            deadline_ms=float(e("PCNN_SERVE_DEADLINE_MS", "0")),
+            conv_backend=e("PCNN_SERVE_CONV_BACKEND", "xla"),
+            precompile=e("PCNN_SERVE_PRECOMPILE", "1") != "0",
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)

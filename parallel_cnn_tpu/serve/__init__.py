@@ -1,0 +1,30 @@
+"""Inference serving subsystem: checkpoint → AOT-compiled, shape-bucketed,
+dynamically batched, replica-sharded predict — the layer that turns the
+training stack's checkpoints into a traffic-serving surface (ROADMAP
+north star; docs/serving.md for the design).
+
+    registry   name → uniform (init, forward, in_shape) model handle
+    engine     AOT per-bucket compile cache, BN folded at compile time,
+               device-pinned replicas (Engine / ReplicaPool)
+    batcher    bounded queue + deadline-aware dynamic batching with
+               typed Overloaded backpressure (DynamicBatcher)
+    telemetry  latency percentiles, queue depth, occupancy, shed rate
+    loadgen    seeded closed-/open-loop traffic + client retry protocol
+"""
+
+from parallel_cnn_tpu.serve.batcher import (  # noqa: F401
+    DeadlineExceeded,
+    DynamicBatcher,
+    Future,
+    Overloaded,
+    serve_stack,
+)
+from parallel_cnn_tpu.serve.engine import (  # noqa: F401
+    Engine,
+    EngineStats,
+    ReplicaPool,
+    bucket_for,
+    load_or_init,
+)
+from parallel_cnn_tpu.serve.registry import ModelHandle, available, get  # noqa: F401
+from parallel_cnn_tpu.serve.telemetry import ServeStats  # noqa: F401

@@ -1,0 +1,313 @@
+"""Dynamic batcher: bounded queue → coalesce → bucket-pad → split.
+
+Queueing model (docs/serving.md has the math):
+
+- ``submit`` is non-blocking. A full bounded queue sheds the request
+  with the typed ``Overloaded`` error — graceful degradation under
+  overload (the client retries with resilience/retry.py backoff, or
+  drops); the alternative (unbounded queue) converts overload into
+  unbounded latency AND host OOM.
+- The worker thread pops the oldest request, then coalesces followers
+  until ``max_batch`` requests OR ``max_wait_ms`` since the first pop —
+  whichever first. max_wait_ms is therefore the batching latency tax an
+  idle-period request pays, and the knob that trades p50 latency for
+  batch occupancy at load.
+- Requests carry optional deadlines; ones already past their deadline at
+  dispatch time are dropped with ``DeadlineExceeded`` instead of wasting
+  a device slot on an answer nobody is waiting for.
+- The dispatched batch pads into the engine's power-of-two bucket and
+  the result rows are split back per request. Dispatch goes through a
+  pool of ``n_replicas`` runner threads, so while replica 0 computes,
+  the worker is already coalescing (and dispatching to replica 1) —
+  that concurrency is what turns replica sharding into throughput.
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+import time
+from typing import Any, List, Optional
+
+import numpy as np
+
+from parallel_cnn_tpu.serve.telemetry import ServeStats
+
+
+class Overloaded(RuntimeError):
+    """Request shed: the bounded request queue is full (backpressure).
+
+    Clients should back off and retry (resilience.retry.RetryPolicy is
+    the house convention — seeded, capped exponential delays) or degrade;
+    the server stays healthy instead of queueing without bound."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """Request dropped: its deadline passed before dispatch."""
+
+
+class Future:
+    """Minimal single-result future resolved by the batcher."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value: Optional[np.ndarray] = None
+        self._error: Optional[BaseException] = None
+        # Observability: which replica served it, in which batch (set at
+        # dispatch; None if the request died before reaching a device).
+        self.replica: Optional[int] = None
+        self.batch_seq: Optional[int] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._event.wait(timeout):
+            raise TimeoutError("request still in flight")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    def _resolve(self, value: np.ndarray) -> None:
+        self._value = value
+        self._event.set()
+
+    def _fail(self, err: BaseException) -> None:
+        self._error = err
+        self._event.set()
+
+
+class _Request:
+    __slots__ = ("x", "deadline", "t_submit", "future")
+
+    def __init__(self, x, deadline, t_submit):
+        self.x = x
+        self.deadline = deadline  # absolute monotonic seconds, or None
+        self.t_submit = t_submit
+        self.future = Future()
+
+
+class DynamicBatcher:
+    """Request front-end over an engine.ReplicaPool.
+
+    ``start=False`` builds the batcher with the worker paused — tests
+    use it to stage the queue deterministically (fill, overload, expire)
+    before a single batch is formed — call ``start()`` to begin serving.
+    Context-manager use closes the batcher (drains nothing: in-flight
+    futures fail with RuntimeError on close).
+    """
+
+    def __init__(
+        self,
+        pool,
+        *,
+        max_wait_ms: float = 2.0,
+        queue_depth: int = 256,
+        deadline_ms: float = 0.0,
+        stats: Optional[ServeStats] = None,
+        start: bool = True,
+    ):
+        self.pool = pool
+        self.max_batch = pool.max_batch
+        self.max_wait_s = max_wait_ms / 1e3
+        self.default_deadline_s = deadline_ms / 1e3 if deadline_ms else None
+        self.stats = stats if stats is not None else ServeStats()
+        self._queue: "queue_mod.Queue[_Request]" = queue_mod.Queue(
+            maxsize=queue_depth
+        )
+        self._stop = threading.Event()
+        self._batch_seq = 0
+        self._runners = [
+            threading.Thread(
+                target=self._runner_loop, name=f"serve-runner-{i}", daemon=True
+            )
+            for i in range(pool.n_replicas)
+        ]
+        # Dispatch queue: formed batches awaiting a runner. Bounded at
+        # the runner count so the worker blocks forming batch k+n until
+        # a replica frees up — keeping requests in the REQUEST queue
+        # (where shedding and deadline drops see them) instead of
+        # accumulating in a hidden second queue.
+        self._dispatch: "queue_mod.Queue" = queue_mod.Queue(
+            maxsize=max(pool.n_replicas, 1)
+        )
+        self._worker = threading.Thread(
+            target=self._worker_loop, name="serve-batcher", daemon=True
+        )
+        self._started = False
+        if start:
+            self.start()
+
+    # -- client surface -------------------------------------------------
+
+    def submit(self, x, deadline_ms: Optional[float] = None) -> Future:
+        """Enqueue one request (a single sample, shape == in_shape).
+
+        Raises Overloaded immediately when the bounded queue is full.
+        ``deadline_ms`` is a per-request budget from now (overrides the
+        batcher default; None keeps the default, 0 disables)."""
+        x = np.asarray(x, dtype=np.float32)
+        if x.shape != tuple(self.pool.handle.in_shape):
+            raise ValueError(
+                f"expected a single sample of shape "
+                f"{tuple(self.pool.handle.in_shape)}, got {x.shape}"
+            )
+        now = time.monotonic()
+        if deadline_ms is None:
+            deadline = (
+                now + self.default_deadline_s
+                if self.default_deadline_s
+                else None
+            )
+        else:
+            deadline = now + deadline_ms / 1e3 if deadline_ms else None
+        req = _Request(x, deadline, now)
+        self.stats.on_submit()
+        try:
+            self._queue.put_nowait(req)
+        except queue_mod.Full:
+            self.stats.on_shed()
+            raise Overloaded(
+                f"request queue full ({self._queue.maxsize} deep); "
+                "back off and retry"
+            ) from None
+        return req.future
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        for t in self._runners:
+            t.start()
+        self._worker.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._started:
+            self._worker.join(timeout=5)
+            for t in self._runners:
+                t.join(timeout=5)
+        # Fail anything still queued so no client blocks forever.
+        for q in (self._queue, self._dispatch):
+            while True:
+                try:
+                    item = q.get_nowait()
+                except queue_mod.Empty:
+                    break
+                reqs = item if isinstance(item, list) else [item]
+                for r in reqs:
+                    if isinstance(r, _Request) and not r.future.done():
+                        r.future._fail(RuntimeError("batcher closed"))
+
+    def __enter__(self) -> "DynamicBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- worker side ----------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.05)
+            except queue_mod.Empty:
+                continue
+            batch = [first]
+            t0 = time.monotonic()
+            while len(batch) < self.max_batch:
+                remaining = t0 + self.max_wait_s - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self._queue.get(timeout=remaining))
+                except queue_mod.Empty:
+                    break
+            now = time.monotonic()
+            live: List[_Request] = []
+            n_expired = 0
+            for r in batch:
+                if r.deadline is not None and now > r.deadline:
+                    r.future._fail(DeadlineExceeded(
+                        f"deadline passed {1e3 * (now - r.deadline):.1f} ms "
+                        "before dispatch"
+                    ))
+                    n_expired += 1
+                else:
+                    live.append(r)
+            if n_expired:
+                self.stats.on_expired(n_expired)
+            if not live:
+                continue
+            replica = self.pool.next_replica()
+            seq = self._batch_seq
+            self._batch_seq += 1
+            self.stats.on_batch(
+                n=len(live),
+                bucket=self.pool.engines[replica].bucket_for(len(live)),
+                replica=replica,
+                queue_depth=self._queue.qsize(),
+            )
+            # Blocks when all runners are busy — deliberate backpressure
+            # (see _dispatch's bound). Bail out on close.
+            while not self._stop.is_set():
+                try:
+                    self._dispatch.put((live, replica, seq), timeout=0.05)
+                    break
+                except queue_mod.Full:
+                    continue
+
+    def _runner_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                live, replica, seq = self._dispatch.get(timeout=0.05)
+            except queue_mod.Empty:
+                continue
+            self._run_batch(live, replica, seq)
+
+    def _run_batch(self, live: List[_Request], replica: int, seq: int) -> None:
+        try:
+            xs = np.stack([r.x for r in live])
+            ys, _ = self.pool.predict(xs, replica=replica)
+            done = time.monotonic()
+            for i, r in enumerate(live):
+                r.future.replica = replica
+                r.future.batch_seq = seq
+                r.future._resolve(ys[i])
+                self.stats.on_complete(done - r.t_submit)
+        except BaseException as e:  # noqa: BLE001 — forwarded to clients
+            self.stats.on_failed(len(live))
+            for r in live:
+                if not r.future.done():
+                    r.future._fail(e)
+
+
+def serve_stack(
+    handle,
+    cfg,
+    *,
+    devices=None,
+    stats: Optional[ServeStats] = None,
+    start: bool = True,
+):
+    """(pool, batcher) wired from a config.ServeConfig — the one-call
+    constructor the CLI, benches, and dryrun share."""
+    from parallel_cnn_tpu.serve.engine import ReplicaPool
+
+    pool = ReplicaPool(
+        handle,
+        n_replicas=cfg.n_replicas,
+        checkpoint=cfg.checkpoint,
+        max_batch=cfg.max_batch,
+        devices=devices,
+        precompile=cfg.precompile,
+    )
+    batcher = DynamicBatcher(
+        pool,
+        max_wait_ms=cfg.max_wait_ms,
+        queue_depth=cfg.queue_depth,
+        deadline_ms=cfg.deadline_ms,
+        stats=stats,
+        start=start,
+    )
+    return pool, batcher

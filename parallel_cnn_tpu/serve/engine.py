@@ -1,0 +1,293 @@
+"""Inference engine: checkpoint → AOT-compiled per-bucket predict.
+
+Design (tentpole of the serve/ subsystem):
+
+- **Restore** goes through train/checkpoint.load_params with the handle's
+  fresh init as the leaf-validated template; zoo checkpoints (full
+  ZooState) restore params + BN running stats and IGNORE the optimizer
+  momentum (``opt_state={}`` contributes no leaves — see load_params).
+- **BN folds at compile time**: the engine closes its predict function
+  over the params/model_state arrays, so inside the traced graph they are
+  constants — XLA constant-folds the eval-mode BatchNorm's
+  ``rsqrt(var+eps)*scale`` per-channel fold (and everything else that
+  depends only on weights) once per bucket, instead of recomputing it on
+  every request.
+- **AOT per shape bucket**: requests pad into the nearest power-of-two
+  batch bucket (1, 2, 4, …, max_batch) and each bucket is compiled ONCE
+  via ``jax.jit(...).lower(...).compile()``. Steady-state requests never
+  trigger a trace: a new shape can only be a new bucket, and with
+  ``precompile()`` not even that. The padding cost is bounded — a bucket
+  is at most 2× its smallest occupant, so padded FLOPs are < 2× useful
+  FLOPs worst-case (docs/serving.md for the amortized math).
+- **Device pinning**: every executable is lowered for one explicit
+  device, so ReplicaPool can pin n engine copies round-robin across local
+  devices and run independent batches concurrently.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class EngineStats:
+    """AOT compile-cache counters (tests pin the hit/miss accounting)."""
+
+    aot_compiles: int = 0
+    aot_hits: int = 0
+    predicts: int = 0
+    compile_seconds: Dict[int, float] = dataclasses.field(default_factory=dict)
+
+
+def load_or_init(handle, checkpoint: Optional[str] = None, seed: int = 0):
+    """(params, model_state) for a handle — restored from a checkpoint
+    when given, else fresh-initialized from ``seed``.
+
+    Accepts both checkpoint dialects: a bare params pytree (the
+    reference-parity LeNet path) and a full zoo ZooState (params + BN
+    stats + optimizer state; the optimizer leaves are ignored — an
+    inference engine must not need to reconstruct the training-time
+    optimizer just to read the weights)."""
+    import jax
+
+    params, model_state = handle.init(jax.random.key(seed))
+    if checkpoint is None:
+        return params, model_state
+    from parallel_cnn_tpu.train import checkpoint as ckpt_lib
+
+    from parallel_cnn_tpu.train.zoo import ZooState
+
+    if jax.tree_util.tree_leaves(model_state):
+        # Stateful model (BN running stats): only the ZooState dialect
+        # can carry the state, so there is nothing to guess.
+        template = ZooState(params, model_state, {})
+        loaded = ckpt_lib.load_params(checkpoint, template)
+        return loaded.params, loaded.model_state
+    # Stateless model: the file may be a bare params pytree (the lenet
+    # parity trainer's dialect) OR a full ZooState whose model_state is
+    # empty (zoo.train always wraps). Key layout disambiguates — try
+    # bare first, fall back to the wrapped template on a leaf-set miss.
+    try:
+        return ckpt_lib.load_params(checkpoint, params), model_state
+    except ValueError as bare_err:
+        try:
+            loaded = ckpt_lib.load_params(
+                checkpoint, ZooState(params, model_state, {})
+            )
+        except ValueError:
+            raise bare_err from None
+        return loaded.params, loaded.model_state
+
+
+def bucket_for(n: int, max_batch: int) -> int:
+    """Smallest power-of-two bucket holding n requests."""
+    if n < 1:
+        raise ValueError(f"need at least one request, got {n}")
+    b = 1 << (n - 1).bit_length()
+    if b > max_batch:
+        raise ValueError(
+            f"batch of {n} exceeds max_batch={max_batch}; split upstream"
+        )
+    return b
+
+
+class Engine:
+    """Single-replica engine: pad → AOT executable → unpad.
+
+    Thread-safe: the compile cache is guarded, and concurrent predict()
+    calls on already-compiled buckets go straight to the executable
+    (jax dispatch is thread-safe).
+    """
+
+    def __init__(
+        self,
+        handle,
+        *,
+        params: Any = None,
+        model_state: Any = None,
+        checkpoint: Optional[str] = None,
+        max_batch: int = 64,
+        device=None,
+        seed: int = 0,
+        precompile: bool = False,
+    ):
+        import jax
+
+        if max_batch < 1 or (max_batch & (max_batch - 1)):
+            raise ValueError(
+                f"max_batch must be a power of two >= 1, got {max_batch}"
+            )
+        self.handle = handle
+        self.max_batch = max_batch
+        self.device = device if device is not None else jax.devices()[0]
+        if params is None:
+            params, model_state = load_or_init(handle, checkpoint, seed)
+        # Pin the weights to this replica's device once; the closures
+        # below capture the pinned copies as trace-time constants.
+        self._params = jax.device_put(params, self.device)
+        self._state = jax.device_put(
+            model_state if model_state is not None else {}, self.device
+        )
+        self.stats = EngineStats()
+        self._exec: Dict[int, Any] = {}
+        self._lock = threading.Lock()
+        if precompile:
+            self.precompile()
+
+    @property
+    def buckets(self) -> List[int]:
+        """The bucket ladder: 1, 2, 4, …, max_batch."""
+        return [1 << i for i in range(self.max_batch.bit_length())]
+
+    def bucket_for(self, n: int) -> int:
+        return bucket_for(n, self.max_batch)
+
+    def _compile(self, bucket: int):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import SingleDeviceSharding
+
+        params, state, handle = self._params, self._state, self.handle
+
+        def predict(x):
+            return handle.forward(params, state, x)
+
+        sds = jax.ShapeDtypeStruct(
+            (bucket, *handle.in_shape), jnp.float32,
+            sharding=SingleDeviceSharding(self.device),
+        )
+        t0 = time.perf_counter()
+        compiled = jax.jit(predict).lower(sds).compile()
+        self.stats.compile_seconds[bucket] = time.perf_counter() - t0
+        return compiled
+
+    def _executable(self, bucket: int):
+        with self._lock:
+            ex = self._exec.get(bucket)
+            if ex is not None:
+                self.stats.aot_hits += 1
+                return ex
+        # Compile outside the lock (minutes on big models — don't block
+        # other buckets), then publish; a racing double-compile is
+        # harmless and keeps the first one.
+        ex = self._compile(bucket)
+        with self._lock:
+            if bucket not in self._exec:
+                self._exec[bucket] = ex
+                self.stats.aot_compiles += 1
+            else:
+                ex = self._exec[bucket]
+            return ex
+
+    def precompile(self) -> Dict[int, float]:
+        """Compile every bucket now; returns {bucket: compile seconds}.
+        Idempotent — already-cached buckets are skipped (not counted as
+        hits: only predict-path lookups feed the hit counter)."""
+        for b in self.buckets:
+            with self._lock:
+                if b in self._exec:
+                    continue
+            ex = self._compile(b)
+            with self._lock:
+                if b not in self._exec:
+                    self._exec[b] = ex
+                    self.stats.aot_compiles += 1
+        return dict(self.stats.compile_seconds)
+
+    def predict(self, x) -> np.ndarray:
+        """(n, *in_shape) float32 → (n, n_outputs) float32.
+
+        Pads to the nearest bucket, runs the bucket's AOT executable on
+        this engine's device, and slices the padding back off. The padded
+        rows run through the model and are discarded — zeros are safe
+        because no eval-mode op in the registered models mixes
+        information across the batch dim (BN uses running stats)."""
+        import jax
+
+        x = np.asarray(x, dtype=np.float32)
+        if x.shape[1:] != tuple(self.handle.in_shape):
+            raise ValueError(
+                f"expected (n, {', '.join(map(str, self.handle.in_shape))}), "
+                f"got {x.shape}"
+            )
+        n = x.shape[0]
+        bucket = self.bucket_for(n)
+        if n < bucket:
+            pad = np.zeros((bucket - n, *x.shape[1:]), x.dtype)
+            x = np.concatenate([x, pad], axis=0)
+        ex = self._executable(bucket)
+        y = ex(jax.device_put(x, self.device))
+        self.stats.predicts += 1
+        return np.asarray(y)[:n]
+
+
+class ReplicaPool:
+    """n_replicas engine copies pinned round-robin across local devices.
+
+    Weights are restored/initialized ONCE on host and re-pinned per
+    replica; each engine owns its per-device AOT executables, so
+    independent batches dispatched to different replicas run genuinely
+    concurrently (no shared compile cache, no shared device queue).
+    Replica selection (`next_replica`) is a deterministic round-robin —
+    tests replay it exactly.
+    """
+
+    def __init__(
+        self,
+        handle,
+        *,
+        n_replicas: int = 1,
+        checkpoint: Optional[str] = None,
+        max_batch: int = 64,
+        devices=None,
+        seed: int = 0,
+        precompile: bool = False,
+    ):
+        import jax
+
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        devices = list(devices) if devices is not None else jax.devices()
+        params, model_state = load_or_init(handle, checkpoint, seed)
+        self.engines = [
+            Engine(
+                handle,
+                params=params,
+                model_state=model_state,
+                max_batch=max_batch,
+                device=devices[i % len(devices)],
+                precompile=precompile,
+            )
+            for i in range(n_replicas)
+        ]
+        self.handle = handle
+        self.max_batch = max_batch
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    @property
+    def n_replicas(self) -> int:
+        return len(self.engines)
+
+    def next_replica(self) -> int:
+        with self._lock:
+            i = self._rr
+            self._rr = (self._rr + 1) % len(self.engines)
+            return i
+
+    def precompile(self) -> Dict[int, float]:
+        out: Dict[int, float] = {}
+        for e in self.engines:
+            out.update(e.precompile())
+        return out
+
+    def predict(self, x, replica: Optional[int] = None) -> Tuple[np.ndarray, int]:
+        """Run one batch on a replica (round-robin unless pinned).
+        Returns (outputs, replica index) so callers can audit placement."""
+        i = self.next_replica() if replica is None else replica
+        return self.engines[i].predict(x), i

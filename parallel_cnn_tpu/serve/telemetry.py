@@ -1,0 +1,130 @@
+"""Serving telemetry: one ServeStats object shared by engine, batcher,
+and CLI — per-request latency percentiles (utils.metrics.Histogram),
+queue depth, batch occupancy, and shed/expiry rates.
+
+Everything here is host-side counters around the device work, so the
+cost per request is a few lock acquisitions — nothing touches jax.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from parallel_cnn_tpu.utils.metrics import Histogram
+
+
+class ServeStats:
+    """Aggregated serving counters. Thread-safe."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # End-to-end request latency (submit → result ready), seconds.
+        self.latency = Histogram(1e-5, 100.0, bins=96)
+        self.submitted = 0
+        self.completed = 0
+        self.shed = 0        # rejected at submit: bounded queue full
+        self.expired = 0     # dropped at dispatch: deadline passed
+        self.failed = 0      # engine-side errors propagated to futures
+        self.batches = 0
+        self.requests_in_batches = 0
+        self.padded_slots = 0       # bucket − occupancy, summed
+        self.queue_depth_sum = 0
+        self.queue_depth_max = 0
+        self.replica_batches: Dict[int, int] = {}
+
+    # -- recording hooks (batcher/engine call these) --------------------
+
+    def on_submit(self) -> None:
+        with self._lock:
+            self.submitted += 1
+
+    def on_shed(self) -> None:
+        with self._lock:
+            self.shed += 1
+
+    def on_expired(self, n: int = 1) -> None:
+        with self._lock:
+            self.expired += n
+
+    def on_batch(self, n: int, bucket: int, replica: int,
+                 queue_depth: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self.requests_in_batches += n
+            self.padded_slots += bucket - n
+            self.queue_depth_sum += queue_depth
+            self.queue_depth_max = max(self.queue_depth_max, queue_depth)
+            self.replica_batches[replica] = (
+                self.replica_batches.get(replica, 0) + 1
+            )
+
+    def on_complete(self, latency_s: float) -> None:
+        self.latency.record(latency_s)
+        with self._lock:
+            self.completed += 1
+
+    def on_failed(self, n: int = 1) -> None:
+        with self._lock:
+            self.failed += n
+
+    # -- views ----------------------------------------------------------
+
+    def shed_rate(self) -> float:
+        with self._lock:
+            return self.shed / self.submitted if self.submitted else 0.0
+
+    def mean_occupancy(self) -> Optional[float]:
+        """Mean fraction of dispatched batch slots holding real requests
+        (padding is the waste dynamic bucketing pays for shape reuse)."""
+        with self._lock:
+            total = self.requests_in_batches + self.padded_slots
+            return self.requests_in_batches / total if total else None
+
+    def snapshot(self) -> Dict[str, Any]:
+        lat = self.latency.summary(scale=1e3)  # ms
+        with self._lock:
+            snap: Dict[str, Any] = {
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "shed": self.shed,
+                "expired": self.expired,
+                "failed": self.failed,
+                "batches": self.batches,
+                "queue_depth_mean": (
+                    self.queue_depth_sum / self.batches if self.batches
+                    else 0.0
+                ),
+                "queue_depth_max": self.queue_depth_max,
+                "replica_batches": dict(self.replica_batches),
+            }
+        snap["shed_rate"] = self.shed_rate()
+        occ = self.mean_occupancy()
+        snap["batch_occupancy"] = occ if occ is not None else 0.0
+        snap["latency_ms"] = lat
+        return snap
+
+    def render(self) -> str:
+        """Human-readable one-screen summary (the CLI's epilogue)."""
+        s = self.snapshot()
+        lat = s["latency_ms"]
+        lines = [
+            f"requests: {s['submitted']} submitted, {s['completed']} ok, "
+            f"{s['shed']} shed, {s['expired']} expired, {s['failed']} failed",
+            f"batches:  {s['batches']} "
+            f"(occupancy {s['batch_occupancy']:.2f}, "
+            f"queue depth mean {s['queue_depth_mean']:.1f} "
+            f"max {s['queue_depth_max']})",
+        ]
+        if lat.get("count"):
+            lines.append(
+                f"latency:  p50 {lat['p50']:.2f} ms, p90 {lat['p90']:.2f} ms, "
+                f"p99 {lat['p99']:.2f} ms (mean {lat['mean']:.2f}, "
+                f"max {lat['max']:.2f})"
+            )
+        if s["replica_batches"]:
+            per = ", ".join(
+                f"r{i}: {n}" for i, n in sorted(s["replica_batches"].items())
+            )
+            lines.append(f"replicas: {per}")
+        return "\n".join(lines)

@@ -74,17 +74,15 @@ def save(path: str, params, state: Optional[TrainState] = None) -> None:
         raise
 
 
-def restore(path: str, like) -> Tuple[Any, TrainState]:
-    """Load a checkpoint into the structure of `like` (a params pytree).
+def _read_arrays(path: str) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    """Parse a checkpoint npz into (stored arrays, metadata).
 
-    Validates that the stored keys/shapes/dtypes exactly match `like` —
-    a renamed layer or changed shape is a hard error, not a silent
-    partial load.
-
-    A torn or bit-flipped file (truncation, corrupted zip member, missing
-    or unparseable metadata) raises ValueError — one typed failure mode the
-    callers (CheckpointRing.restore_latest, CLI --resume) can catch to skip
-    to an older checkpoint instead of crashing on whatever numpy/zipfile
+    The single home of the torn/corrupt/version-mismatch contract: a
+    truncated file, corrupted zip member, missing or unparseable
+    metadata, or a format-version mismatch all raise ValueError — one
+    typed failure mode every caller (restore, load_params,
+    CheckpointRing.restore_latest, CLI --resume) can catch to skip to an
+    older checkpoint instead of crashing on whatever numpy/zipfile
     internals the damage happened to hit.
     """
     try:
@@ -100,6 +98,38 @@ def restore(path: str, like) -> Tuple[Any, TrainState]:
         raise ValueError(
             f"checkpoint version {meta.get('version')} != {FORMAT_VERSION}"
         )
+    return stored, meta
+
+
+def _check_leaves(stored: Dict[str, np.ndarray], want: Dict[str, np.ndarray]):
+    for k, w in want.items():
+        if stored[k].shape != w.shape or stored[k].dtype != w.dtype:
+            raise ValueError(
+                f"checkpoint leaf '{k}' is {stored[k].shape}/{stored[k].dtype}"
+                f", expected {w.shape}/{w.dtype}"
+            )
+
+
+def _unflatten_into(like, stored: Dict[str, np.ndarray]):
+    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(like)
+    new_leaves = []
+    for path_keys, _ in leaves_with_path:
+        key = "/".join(
+            str(getattr(p, "key", getattr(p, "idx", p))) for p in path_keys
+        )
+        new_leaves.append(jax.numpy.asarray(stored[key]))
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def restore(path: str, like) -> Tuple[Any, TrainState]:
+    """Load a checkpoint into the structure of `like` (a params pytree).
+
+    Validates that the stored keys/shapes/dtypes exactly match `like` —
+    a renamed layer or changed shape is a hard error, not a silent
+    partial load. Damage and version skew raise the typed ValueError of
+    `_read_arrays`.
+    """
+    stored, meta = _read_arrays(path)
 
     want = _flatten(like)
     if set(stored) != set(want):
@@ -109,27 +139,42 @@ def restore(path: str, like) -> Tuple[Any, TrainState]:
             f"checkpoint structure mismatch: missing={sorted(missing)} "
             f"surplus={sorted(surplus)}"
         )
-    for k, w in want.items():
-        if stored[k].shape != w.shape or stored[k].dtype != w.dtype:
-            raise ValueError(
-                f"checkpoint leaf '{k}' is {stored[k].shape}/{stored[k].dtype}"
-                f", expected {w.shape}/{w.dtype}"
-            )
+    _check_leaves(stored, want)
 
-    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(like)
-    new_leaves = []
-    for path_keys, _ in leaves_with_path:
-        key = "/".join(
-            str(getattr(p, "key", getattr(p, "idx", p))) for p in path_keys
-        )
-        new_leaves.append(jax.numpy.asarray(stored[key]))
-    params = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    params = _unflatten_into(like, stored)
     state = TrainState(
         epoch=meta["epoch"],
         epoch_errors=list(meta["epoch_errors"]),
         extra=dict(meta["extra"]),
     )
     return params, state
+
+
+def load_params(path: str, like):
+    """Inference-only restore: the subtree of `like` out of a checkpoint,
+    without the TrainState.
+
+    Unlike `restore`, SURPLUS stored keys are ignored — that is the
+    point: a zoo training checkpoint persists the full ZooState
+    (params + BN stats + optimizer momentum), and a serving engine wants
+    params + model_state without having to reconstruct the exact
+    optimizer that produced opt_state (whose leaf structure varies with
+    schedule/weight-decay choices). Pass `like` with the unwanted
+    subtrees EMPTY (e.g. ``ZooState(params, model_state, opt_state={})``)
+    — empty containers contribute no leaves, so their stored arrays
+    become ignorable surplus. MISSING or shape/dtype-mismatched wanted
+    keys still hard-error, and file damage / version skew raises the same
+    typed ValueError as `restore` (shared `_read_arrays`).
+    """
+    stored, _ = _read_arrays(path)
+    want = _flatten(like)
+    missing = set(want) - set(stored)
+    if missing:
+        raise ValueError(
+            f"checkpoint {path!r} lacks required leaves: {sorted(missing)}"
+        )
+    _check_leaves(stored, want)
+    return _unflatten_into(like, stored)
 
 
 def latest(directory: str, prefix: str = "ckpt_") -> Optional[str]:

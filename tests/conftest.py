@@ -75,3 +75,8 @@ def pytest_configure(config):
         "comm: gradient-collective tests (parallel/collectives.py — "
         "bucketizer round-trip, ring vs psum parity, bf16 wire)",
     )
+    config.addinivalue_line(
+        "markers",
+        "serve: inference-serving tests (serve/ — bucket padding parity, "
+        "AOT cache accounting, batcher backpressure/deadlines, loadgen)",
+    )
