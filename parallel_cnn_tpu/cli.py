@@ -20,6 +20,7 @@ from parallel_cnn_tpu.config import (
     CommConfig,
     Config,
     DataConfig,
+    FusedStepConfig,
     MeshConfig,
     ResilienceConfig,
     ServeConfig,
@@ -119,6 +120,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="collective payload dtype on the wire; bfloat16 "
                         "halves ICI bytes, accumulation stays f32 "
                         "(PCNN_COMM_WIRE_DTYPE)")
+    p.add_argument("--fused-step", action="store_true",
+                   help="fused training step (PCNN_FUSED_STEP): fused "
+                        "pool→FC→softmax-CE loss tail, bf16 activations "
+                        "over f32 masters with loss scaling, and — on "
+                        "zoo mesh runs with --comm-impl ring — the "
+                        "update-on-arrival fused optimizer "
+                        "(ops/pallas_update.py)")
+    p.add_argument("--act-dtype", default=None,
+                   choices=["float32", "bfloat16"],
+                   help="fused-step activation dtype (PCNN_ACT_DTYPE; "
+                        "default bfloat16). Refines --fused-step only — "
+                        "it never enables the fused path by itself")
     p.add_argument("--checkpoint-dir", default=None,
                    help="save ckpt_<epoch>.npz per epoch; --resume restarts "
                         "from the latest")
@@ -217,8 +230,22 @@ def config_from_args(args: argparse.Namespace) -> Config:
                           else base.bucket_bytes),
             wire_dtype=args.comm_wire_dtype or base.wire_dtype,
         )
+    # Same env-then-flags layering for the fused step. --act-dtype only
+    # REFINES an enabled fused path (acceptance: nothing but
+    # --fused-step / PCNN_FUSED_STEP changes the default behavior).
+    fused = FusedStepConfig.from_env()
+    if args.fused_step:
+        fused = fused or FusedStepConfig()
+    if args.act_dtype is not None:
+        if fused is None:
+            raise SystemExit(
+                "--act-dtype refines the fused step; enable it with "
+                "--fused-step (or PCNN_FUSED_STEP=1) first"
+            )
+        fused = dataclasses.replace(fused, act_dtype=args.act_dtype)
     return Config(data=data, train=train, mesh=mesh,
-                  resilience=resilience, comm=comm, model=args.model)
+                  resilience=resilience, comm=comm, fused=fused,
+                  model=args.model)
 
 
 def build_serve_parser(cmd: str) -> argparse.ArgumentParser:
@@ -311,8 +338,12 @@ def _run_serve(cmd: str, argv: List[str]) -> int:
     benchmarker's view: the same stack under a chosen arrival pattern,
     reporting client-side p50/p90/p99 and shed rate (optionally as JSON).
     No network listener on purpose: this environment has no ingress, so
-    the serving surface is in-process (batcher.submit) and the transport
-    layer stays a documented TODO (docs/serving.md).
+    the serving surface is in-process (batcher.submit). The transport
+    layer is a TRACKED design, not an open TODO — docs/future_work.md §6
+    pins it: an HTTP/gRPC adapter strictly in front of DynamicBatcher
+    .submit (decode → submit → await → encode; Overloaded ⇒ 429 +
+    Retry-After, DeadlineExceeded ⇒ 504), everything behind that line
+    already load-tested by serve/loadgen.py.
     """
     args = build_serve_parser(cmd).parse_args(argv)
     cfg = _serve_config_from_args(args)
@@ -618,6 +649,7 @@ def _run_zoo(args: argparse.Namespace, cfg: Config) -> int:
             mesh=mesh,
             model_axis=model_axis,
             comm=cfg.comm,
+            fused=cfg.fused,
             seed=args.seed,
             eval_data=(ev_imgs, ev_labels),
             checkpoint_dir=args.checkpoint_dir,

@@ -208,6 +208,79 @@ class CommConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class FusedStepConfig:
+    """Fused end-to-end train-step policy (round 7).
+
+    The default (no FusedStepConfig at all — Config.fused is None) keeps
+    every historical code path byte-for-byte: the optimizer stays a
+    tree-wide post-collective optax pass, the loss tail stays the unfused
+    pool→flatten→dense→softmax-CE composition, activations stay f32.
+    Constructing one (--fused-step / PCNN_FUSED_STEP=1) opts a run into
+    the fused step, whose three pieces are individually gated:
+
+    - ``update`` — update-on-arrival bucketed SGD/momentum
+      (ops/pallas_update.py): each gradient bucket's param+momentum
+      update launches as soon as its ring reduce-scatter sum is final,
+      and the final all-gather ships already-updated parameter shards.
+      Requires the explicit ring collective path (CommConfig impl="ring"
+      on a mesh) and constant-LR SGD+momentum without weight decay — the
+      update math is baked into the kernel, not an optax chain.
+    - ``tail`` — the fused pool→flatten→FC→softmax-CE kernel with a
+      custom VJP that emits dlogits from the forward
+      (ops/pallas_tail.py); models whose head doesn't match a supported
+      tail pattern degrade to the unfused composition with a log line.
+    - ``act_dtype`` — activation/compute dtype for the fused path.
+      Defaults to bfloat16 (f32 master weights; grads/updates stay f32).
+      bf16 runs carry a dynamic loss scale: the scaled loss keeps bf16
+      backprop cotangents in range, gradient overflow SKIPS the update
+      in-step and multiplies the scale by ``backoff`` (the resilience
+      sentinel reports it as a handled overflow instead of rolling
+      back), and ``growth_interval`` consecutive good steps double it.
+      act_dtype="float32" keeps exact numerics (scale pinned to 1).
+    """
+
+    update: bool = True
+    tail: bool = True
+    act_dtype: str = "bfloat16"
+    loss_scale: float = 2.0 ** 15
+    growth_interval: int = 200
+    backoff: float = 0.5
+
+    def __post_init__(self):
+        if self.act_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"unknown act dtype {self.act_dtype!r} "
+                "(float32 or bfloat16)"
+            )
+        if self.loss_scale < 1.0:
+            raise ValueError(
+                f"loss_scale must be >= 1, got {self.loss_scale}"
+            )
+        if self.growth_interval < 1:
+            raise ValueError(
+                f"growth_interval must be >= 1, got {self.growth_interval}"
+            )
+        if not 0.0 < self.backoff < 1.0:
+            raise ValueError(
+                f"backoff must be in (0, 1), got {self.backoff}"
+            )
+
+    @staticmethod
+    def from_env() -> Optional["FusedStepConfig"]:
+        """FusedStepConfig when PCNN_FUSED_STEP is set truthy, else None
+        (→ every historical path unchanged). PCNN_ACT_DTYPE refines the
+        activation dtype but does not by itself opt in — the acceptance
+        contract is that ONLY --fused-step/PCNN_FUSED_STEP changes
+        behavior."""
+        enabled = os.environ.get("PCNN_FUSED_STEP")
+        if enabled is None or enabled == "0":
+            return None
+        return FusedStepConfig(
+            act_dtype=os.environ.get("PCNN_ACT_DTYPE", "bfloat16"),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class ServeConfig:
     """Inference-serving policy (serve/ subsystem — the layer that turns
     training checkpoints into a request-serving surface; docs/serving.md
@@ -288,6 +361,10 @@ class Config:
     # None = historical implicit collectives (monolithic psum / GSPMD);
     # a CommConfig opts mesh training into parallel/collectives.py.
     comm: Optional[CommConfig] = None
+    # None = the historical unfused step; a FusedStepConfig opts into the
+    # round-7 fused path (update-on-arrival optimizer, fused loss tail,
+    # bf16 activations with dynamic loss scaling).
+    fused: Optional[FusedStepConfig] = None
     model: str = "lenet_ref"
 
     def replace(self, **kw) -> "Config":

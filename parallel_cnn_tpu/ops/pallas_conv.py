@@ -357,11 +357,16 @@ def _pick_bb(
     out_esz: int,
     w_bytes: int,
     pair_temps: int = 0,
+    tag: str = "conv",
 ) -> int:
     """Images per grid step under the VMEM model: double-buffered in/out
     pipeline blocks, Mosaic's materialized per-tap slice copies (input
     dtype), f32 accumulator + per-tap dot result, minus the
-    double-buffered weight block.
+    double-buffered weight block. ``tag`` labels the over-budget logs —
+    the fused-update kernels (ops/pallas_update.py) size their blocks
+    through this same model (their momentum buffer rides in cins/couts,
+    charged like any other double-buffered pipeline operand) and get the
+    same warning/debug trail.
 
     Mosaic tiling constraint (r5 on-chip finding — interpret-mode tests
     can't catch it): a block's SUBLANE dim (bb·rows) must be a multiple
@@ -402,15 +407,15 @@ def _pick_bb(
     modeled = bb * per_img + 2 * w_bytes
     if modeled > _VMEM_LIMIT:
         log.warning(
-            "pallas conv block bb=%d models %.1fMB VMEM, over the %.0fMB "
+            "pallas %s block bb=%d models %.1fMB VMEM, over the %.0fMB "
             "limit — expect a Mosaic OOM at this shape",
-            bb, modeled / 2**20, _VMEM_LIMIT / 2**20,
+            tag, bb, modeled / 2**20, _VMEM_LIMIT / 2**20,
         )
     elif modeled > _VMEM_BUDGET:
         log.debug(
-            "pallas conv block bb=%d models %.1fMB VMEM, over the %.0fMB "
+            "pallas %s block bb=%d models %.1fMB VMEM, over the %.0fMB "
             "budget (tiling forced a larger-than-wanted block)",
-            bb, modeled / 2**20, _VMEM_BUDGET / 2**20,
+            tag, bb, modeled / 2**20, _VMEM_BUDGET / 2**20,
         )
     return bb
 

@@ -1,0 +1,170 @@
+"""Fused SGD/momentum update kernels over 1-D gradient buckets (round 7).
+
+The unfused trainers end every step with a tree-wide optimizer pass: for
+each leaf, read param + grad (+ momentum) from HBM, write param
+(+ momentum) back — after the collective barrier, on the critical path.
+These kernels collapse that pass to ONE fused elementwise kernel per
+*bucket* (the same fixed-byte buckets parallel/collectives.py ships over
+the ring), which is what makes *update-on-arrival* possible: the zoo's
+explicit-collective step (train/zoo.py:make_fused_train_step) launches
+bucket b's param+momentum update the moment its reduce-scatter sum is
+final, overlapped with the other buckets' in-flight collectives, and
+all-gathers already-updated parameter shards — no post-barrier optimizer
+pass at all (the arXiv:1810.11112 schedule, extended from grads to the
+update itself).
+
+Math (per element, f32 throughout — master precision):
+
+    fused_sgd:           p' = p − lr · (g · scale)
+    fused_sgd_momentum:  m' = β·m + g · scale;   p' = p − lr · m'
+
+which is exactly `optax.sgd(lr, momentum=β)` on grads pre-scaled by
+``scale`` (the caller folds loss-scale × accumulation × device count into
+one multiplier; tests/test_fused_step.py pins the bit-equality). ``scale``
+is a *traced* scalar — the dynamic loss scale rides in it — passed as a
+(1,1) block like the LeNet kernels' scalar operands; lr/β are static.
+
+The LeNet engine's `p += dt·g` ascent convention is the same kernel with
+``lr = −dt`` (train/step.py:fused_batched_step).
+
+Buckets are 1-D; the wrappers pad to a lane multiple and present the
+kernel a rank-2 (rows, 128) view — Mosaic-native tiling, no in-kernel
+reshapes. Block row counts come from ops.pallas_conv._pick_bb so the
+momentum buffer is charged in the same VMEM model (and trips the same
+over-budget logs) as the conv pipeline operands.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from parallel_cnn_tpu.ops.pallas import _interpret
+from parallel_cnn_tpu.ops import pallas_conv
+from parallel_cnn_tpu.parallel import collectives
+
+_LANES = 128
+
+
+def _sgd_kernel(p_ref, g_ref, s_ref, o_ref, *, lr):
+    o_ref[:] = p_ref[:] - lr * (g_ref[:] * s_ref[0, 0])
+
+
+def _sgd_momentum_kernel(p_ref, m_ref, g_ref, s_ref, po_ref, mo_ref, *,
+                         lr, momentum):
+    m = momentum * m_ref[:] + g_ref[:] * s_ref[0, 0]
+    mo_ref[:] = m
+    po_ref[:] = p_ref[:] - lr * m
+
+
+def _pick_rows(n_rows: int, n_in: int, n_out: int) -> int:
+    """Rows of 128 f32 lanes per grid step, via the conv VMEM model: each
+    flat row is one 'image' of one row; every operand (params, grads, and
+    — for the momentum variant — the momentum buffer, in AND out) is a
+    double-buffered 128-lane pipeline block. Routing through _pick_bb is
+    what charges the momentum buffer against the shared budget and emits
+    the same over-budget warning/debug logs as the conv kernels."""
+    return pallas_conv._pick_bb(
+        n_rows, 1,
+        cins=[_LANES] * n_in, tap_cins=[], couts=[_LANES] * n_out,
+        esz=4, out_esz=4, w_bytes=0, tag="update",
+    )
+
+
+def _as_rows(x: jax.Array) -> Tuple[jax.Array, int]:
+    """(rows, 128) zero-padded view of a 1-D f32 buffer + original length."""
+    n = x.shape[0]
+    rows = -(-n // _LANES)
+    pad = rows * _LANES - n
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad,), x.dtype)])
+    return x.reshape(rows, _LANES), n
+
+
+def _scale_arr(scale) -> jax.Array:
+    return jnp.asarray(scale, jnp.float32).reshape(1, 1)
+
+
+def fused_sgd(p: jax.Array, g: jax.Array, *, lr: float,
+              scale=1.0) -> jax.Array:
+    """p − lr·(g·scale) for 1-D f32 buffers of equal length, one kernel."""
+    if p.shape != g.shape or p.ndim != 1:
+        raise ValueError(f"expected matching 1-D buffers, got {p.shape} "
+                         f"vs {g.shape}")
+    p2, n = _as_rows(p.astype(jnp.float32))
+    g2, _ = _as_rows(g.astype(jnp.float32))
+    rows = p2.shape[0]
+    bb = _pick_rows(rows, n_in=2, n_out=1)
+    row_spec = pl.BlockSpec((bb, _LANES), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        functools.partial(_sgd_kernel, lr=float(lr)),
+        grid=(rows // bb,),
+        in_specs=[
+            row_spec, row_spec,
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=row_spec,
+        out_shape=jax.ShapeDtypeStruct(p2.shape, jnp.float32),
+        compiler_params=pallas_conv._compiler_params(),
+        interpret=_interpret(),
+    )(p2, g2, _scale_arr(scale))
+    return out.reshape(-1)[:n]
+
+
+def fused_sgd_momentum(p: jax.Array, m: jax.Array, g: jax.Array, *,
+                       lr: float, momentum: float,
+                       scale=1.0) -> Tuple[jax.Array, jax.Array]:
+    """(p', m') with m' = β·m + g·scale and p' = p − lr·m', one kernel."""
+    if not (p.shape == m.shape == g.shape) or p.ndim != 1:
+        raise ValueError(f"expected matching 1-D buffers, got {p.shape} / "
+                         f"{m.shape} / {g.shape}")
+    p2, n = _as_rows(p.astype(jnp.float32))
+    m2, _ = _as_rows(m.astype(jnp.float32))
+    g2, _ = _as_rows(g.astype(jnp.float32))
+    rows = p2.shape[0]
+    bb = _pick_rows(rows, n_in=3, n_out=2)
+    row_spec = pl.BlockSpec((bb, _LANES), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    po, mo = pl.pallas_call(
+        functools.partial(_sgd_momentum_kernel, lr=float(lr),
+                          momentum=float(momentum)),
+        grid=(rows // bb,),
+        in_specs=[
+            row_spec, row_spec, row_spec,
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(row_spec, row_spec),
+        out_shape=(
+            jax.ShapeDtypeStruct(p2.shape, jnp.float32),
+            jax.ShapeDtypeStruct(p2.shape, jnp.float32),
+        ),
+        compiler_params=pallas_conv._compiler_params(),
+        interpret=_interpret(),
+    )(p2, m2, g2, _scale_arr(scale))
+    return po.reshape(-1)[:n], mo.reshape(-1)[:n]
+
+
+def tree_sgd(params, grads, *, lr: float, scale=1.0,
+             bucket_bytes: int = collectives.DEFAULT_BUCKET_BYTES):
+    """Tree-wide fused SGD through the bucket machinery: the pytree is
+    packed into collectives.plan_buckets buckets (the exact flatten/
+    unflatten round-trip), each bucket updated by ONE fused_sgd kernel.
+
+    This is the single-device consumer of the bucket machinery — the
+    LeNet engine's update (train/step.py:fused_batched_step; lr = −dt for
+    the reference's p += dt·g convention). The zoo's distributed
+    update-on-arrival path applies the same kernels per bucket *shard*
+    inside its shard_map instead (train/zoo.py)."""
+    plan = collectives.plan_buckets(params, bucket_bytes, shards=1)
+    pb = collectives.flatten_buckets(params, plan)
+    gb = collectives.flatten_buckets(grads, plan)
+    out: List[jax.Array] = [
+        fused_sgd(p, g, lr=lr, scale=scale) for p, g in zip(pb, gb)
+    ]
+    return collectives.unflatten_buckets(out, plan)

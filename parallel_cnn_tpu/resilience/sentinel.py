@@ -83,3 +83,38 @@ class Sentinel:
             if tree is not None and not bool(tree_all_finite(tree)):
                 return Verdict(False, f"non-finite {name}")
         return Verdict(True)
+
+    def check_scaled(
+        self,
+        *,
+        loss: Optional[float] = None,
+        params: Any = None,
+        skipped_before: int = 0,
+        skipped_now: int = 0,
+        scale: float = 1.0,
+    ) -> Verdict:
+        """``check`` variant for the dynamic-loss-scaling step (round 7).
+
+        Under bf16 loss scaling an overflow is an *expected* event, not a
+        divergence: the fused step already detected the non-finite
+        gradient shard, dropped the update in-place (params/momentum kept
+        bit-identical), and backed the scale off — all inside the jitted
+        step. If the step's skip counter advanced and the master weights
+        are still finite, the overflow was handled; report healthy with
+        the reason attached so verbose drivers can log it. Anything the
+        step did NOT absorb (non-finite loss with no new skip, poisoned
+        params) falls through to the usual unhealthy verdict and the
+        configured raise/skip/rollback policy.
+        """
+        base = self.check(loss=loss, params=params)
+        if base.healthy:
+            return base
+        if skipped_now > skipped_before and (
+            params is None or bool(tree_all_finite(params))
+        ):
+            return Verdict(
+                True,
+                "loss-scale overflow handled in-step: update skipped "
+                f"({skipped_now - skipped_before}x), scale now {scale:g}",
+            )
+        return base

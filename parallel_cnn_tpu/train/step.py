@@ -126,6 +126,36 @@ def batched_step(
 @functools.partial(
     jax.jit, static_argnames=("dt", "compute_dtype"), donate_argnums=(0,)
 )
+def fused_batched_step(
+    params: Params,
+    x: jax.Array,
+    y: jax.Array,
+    dt: float,
+    compute_dtype: str | None = None,
+) -> Tuple[Params, jax.Array]:
+    """`batched_step` with the round-7 fused bucket update: same
+    `local_grad_sums` engine, but the per-leaf `p += dt·g` tree pass is
+    replaced by ONE ops.pallas_update kernel per gradient bucket
+    (tree_sgd) — the single-device consumer of the update-on-arrival
+    kernels. The batch mean rides in the kernel's scalar operand
+    (scale=1/B) and the reference's gradient-ASCENT convention maps to
+    lr=−dt, so the update is `p − (−dt)·(g_sum/B)` — numerically the
+    `apply_grad ∘ mean` composition, bit-compared in
+    tests/test_fused_step.py.
+    """
+    from parallel_cnn_tpu.ops import pallas_update
+
+    err_sum, grad_sums = local_grad_sums(params, x, y, compute_dtype)
+    n = x.shape[0]
+    params = pallas_update.tree_sgd(
+        params, grad_sums, lr=-dt, scale=1.0 / n
+    )
+    return params, err_sum / n
+
+
+@functools.partial(
+    jax.jit, static_argnames=("dt", "compute_dtype"), donate_argnums=(0,)
+)
 def pallas_batched_step(
     params: Params,
     x: jax.Array,
@@ -156,7 +186,8 @@ def pallas_batched_step(
     return apply_grad(params, mean_grads, dt), err.astype(jnp.float32)
 
 
-def batched_step_fn(ops_path: str, fallback: bool = False):
+def batched_step_fn(ops_path: str, fallback: bool = False,
+                    fused: bool = False):
     """The minibatch step for a TrainConfig.ops value.
 
     ``fallback=True`` (cfg.resilience.pallas_fallback, trainer-driven
@@ -166,9 +197,14 @@ def batched_step_fn(ops_path: str, fallback: bool = False):
     the run completes instead of dying. Direct callers (the differential
     kernel tests) keep the strict default: a Pallas failure is a Pallas
     failure.
+
+    ``fused=True`` (cfg.fused, i.e. --fused-step / PCNN_FUSED_STEP)
+    selects the fused bucket-update step on the reference grad engine;
+    the Pallas megakernel path keeps its own update (its step is one
+    fused program already).
     """
     if ops_path != "pallas":
-        return batched_step
+        return fused_batched_step if fused else batched_step
     if not fallback:
         return pallas_batched_step
     from parallel_cnn_tpu.resilience.retry import with_fallback

@@ -174,7 +174,8 @@ def learn(
     # kernel-path failure (e.g. Mosaic compile error on an unsupported
     # toolchain) logs one warning and completes the run on path A.
     batched_step = step_lib.batched_step_fn(
-        tc.ops, fallback=res.pallas_fallback
+        tc.ops, fallback=res.pallas_fallback,
+        fused=cfg.fused is not None,
     )
 
     # dt is a local because auto-rollback may scale it (res.lr_backoff);

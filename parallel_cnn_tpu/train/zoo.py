@@ -44,10 +44,95 @@ class ZooState:
     opt_state: Any
 
 
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class FusedOptState:
+    """Optimizer state of the update-on-arrival step (round 7).
+
+    The momentum lives PERSISTENTLY SHARDED — one ``(n_data, bucket_len //
+    n_data)`` f32 leaf per collectives bucket, each device owning its own
+    row — because the fused step only ever touches the local shard: the
+    reduce-scattered gradient shard updates it in place and the updated
+    *param* shard is what the final all-gather ships. The dynamic
+    loss-scale state (scale / good-step counter / skip counter) rides in
+    the same pytree so it checkpoints, donates, and resumes with the rest
+    of ZooState.
+    """
+
+    mom: Any                 # per-bucket momentum shards, (n_data, L) f32
+    scale: jax.Array         # f32 scalar: current dynamic loss scale
+    good_steps: jax.Array    # i32: overflow-free steps since last change
+    skipped: jax.Array       # i32: total updates dropped on overflow
+
+
 def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(jnp.float32), labels
     ).mean()
+
+
+def _build_loss_fn(model: Module, fused) -> Callable:
+    """The zoo loss closure, with the round-7 fused-step refinements.
+
+    fused=None reproduces the historical loss exactly. With a
+    config.FusedStepConfig: (a) ``act_dtype="bfloat16"`` casts the input
+    and every float param leaf to bf16 at the TOP of the traced loss —
+    the f32 masters live outside the graph, and the cast's transpose
+    returns f32 gradients, so the optimizer math stays master-precision;
+    (b) ``tail=True`` routes a recognized pool→flatten→Dense suffix
+    through ops.pallas_tail.fused_tail_loss (custom VJP emitting dlogits
+    directly), degrading to the unfused composition — with a one-time
+    note — when the model's head doesn't match a supported pattern.
+    """
+    if fused is None:
+        def loss_fn(params, model_state, x, y):
+            logits, new_state = model.apply(params, model_state, x,
+                                            train=True)
+            return cross_entropy(logits, y), new_state
+
+        return loss_fn
+
+    from parallel_cnn_tpu.ops import pallas_tail
+
+    act = jnp.dtype(fused.act_dtype)
+    split = pallas_tail.split_tail(model) if fused.tail else None
+    if fused.tail and split is None:
+        print("fused-step: model tail not fusable; keeping unfused tail")
+
+    def loss_fn(params, model_state, x, y):
+        if act != jnp.float32:
+            x = x.astype(act)
+            params = jax.tree_util.tree_map(
+                lambda p: p.astype(act)
+                if jnp.issubdtype(p.dtype, jnp.floating)
+                else p,
+                params,
+            )
+        if split is None:
+            logits, new_state = model.apply(params, model_state, x,
+                                            train=True)
+            return cross_entropy(logits, y), new_state
+        feats = x
+        new_states = []
+        for layer, p, s in zip(
+            model.layers[: split.trunk],
+            params[: split.trunk],
+            model_state[: split.trunk],
+            strict=True,
+        ):
+            feats, s = layer.apply(p, s, feats, train=True)
+            new_states.append(s)
+        # The fused tail replaces layers[trunk:]; those layers carry no
+        # state (empty dicts) — append them unchanged so the new state
+        # list keeps Sequential's aligned structure.
+        new_states.extend(model_state[split.trunk :])
+        dense = params[-1]
+        loss = pallas_tail.fused_tail_loss(
+            feats, dense["w"], dense["b"], y, pool=split.pool
+        )
+        return loss, new_states
+
+    return loss_fn
 
 
 def make_optimizer(
@@ -97,6 +182,43 @@ def init_state(
     return ZooState(params, model_state, optimizer.init(params))
 
 
+def init_fused_state(
+    model: Module,
+    key: jax.Array,
+    in_shape: Tuple[int, ...],
+    *,
+    n_data: int,
+    fused,
+    bucket_bytes: int,
+) -> Tuple[ZooState, int]:
+    """(ZooState for the update-on-arrival step, bucket count).
+
+    Momentum is allocated per collectives bucket in its SHARDED layout
+    (see FusedOptState) — the bucket plan from the params tree is
+    identical to the one the step derives from the gradient tree (same
+    structure, shapes, dtypes), so shard lengths line up by construction.
+    The loss scale starts at ``fused.loss_scale`` on the bf16 path and at
+    1.0 for f32 (where scaling is the identity).
+    """
+    from parallel_cnn_tpu.parallel import collectives
+
+    params, model_state, _ = model.init(key, in_shape)
+    plan = collectives.plan_buckets(params, bucket_bytes, shards=n_data)
+    buckets = collectives.flatten_buckets(params, plan)
+    mom = [
+        jnp.zeros((n_data, b.shape[0] // n_data), jnp.float32)
+        for b in buckets
+    ]
+    scale0 = fused.loss_scale if fused.act_dtype == "bfloat16" else 1.0
+    opt = FusedOptState(
+        mom=mom,
+        scale=jnp.float32(scale0),
+        good_steps=jnp.int32(0),
+        skipped=jnp.int32(0),
+    )
+    return ZooState(params, model_state, opt), len(buckets)
+
+
 def make_train_step(
     model: Module,
     optimizer: optax.GradientTransformation,
@@ -105,6 +227,7 @@ def make_train_step(
     augment: Optional[Callable] = None,
     model_axis: bool = False,
     comm=None,
+    fused=None,
 ) -> Callable:
     """Build the jitted train step: (state, x, y) -> (state, loss), or
     (state, x, y, key) -> (state, loss) when `augment` is given.
@@ -131,9 +254,26 @@ def make_train_step(
     optional bf16 wire and microbatch comm/compute overlap. Requires
     ``mesh``; mutually exclusive with model_axis (the explicit path is
     data-axis only — GSPMD keeps owning the 2-D decomposition).
+
+    ``fused`` (a config.FusedStepConfig) applies the round-7 fused-tail /
+    bf16-activation loss refinements (_build_loss_fn). On the bf16 path a
+    STATIC loss scale protects the half-precision backward: the loss is
+    scaled before differentiation and grads/loss unscaled by the exact
+    power-of-two reciprocal right after each microbatch backward — the
+    accumulation and optax math run in the unscaled domain, numerically
+    identical to unscaled f32 up to bf16 rounding. The DYNAMIC scaling
+    policy (skip + rescale on overflow) needs the update-on-arrival step:
+    ``fused.update=True`` is rejected here — build via
+    ``make_fused_train_step`` (train() dispatches automatically).
     """
     if model_axis and mesh is None:
         raise ValueError("model_axis=True requires a mesh")
+    if fused is not None and fused.update:
+        raise ValueError(
+            "fused.update (update-on-arrival) requires the explicit "
+            "ring-collective step — use make_fused_train_step / "
+            "train(..., fused=...), or pass fused with update=False"
+        )
     if comm is not None:
         if mesh is None:
             raise ValueError("comm (explicit collectives) requires a mesh")
@@ -143,18 +283,36 @@ def make_train_step(
                 "model_axis sharding stays on the GSPMD path (comm=None)"
             )
         return _make_comm_step(model, optimizer, accum_steps, mesh,
-                               augment, comm)
+                               augment, comm, fused)
 
-    def loss_fn(params, model_state, x, y):
-        logits, new_state = model.apply(params, model_state, x, train=True)
-        return cross_entropy(logits, y), new_state
+    loss_fn = _build_loss_fn(model, fused)
+    scale = (
+        float(fused.loss_scale)
+        if fused is not None and fused.act_dtype == "bfloat16"
+        else 1.0
+    )
+
+    def grad_fn(params, model_state, bx, by):
+        if scale == 1.0:
+            (loss, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, model_state, bx, by)
+            return loss, new_state, grads
+
+        def scaled(params, model_state, bx, by):
+            loss, new_state = loss_fn(params, model_state, bx, by)
+            return loss * scale, (loss, new_state)
+
+        grads, (loss, new_state) = jax.grad(scaled, has_aux=True)(
+            params, model_state, bx, by
+        )
+        # 1/scale is an exact power of two: unscaling is bit-lossless.
+        grads = jax.tree_util.tree_map(lambda g: g * (1.0 / scale), grads)
+        return loss, new_state, grads
 
     def microbatch_grads(params, model_state, x, y):
         if accum_steps == 1:
-            (loss, new_state), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(params, model_state, x, y)
-            return loss, new_state, grads
+            return grad_fn(params, model_state, x, y)
 
         if x.shape[0] % accum_steps:
             raise ValueError(
@@ -181,9 +339,7 @@ def make_train_step(
                 bx, gsum, lsum, model_state = jax.lax.optimization_barrier(
                     (bx, gsum, lsum, model_state)
                 )
-            (loss, model_state), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(params, model_state, bx, by)
+            loss, model_state, grads = grad_fn(params, model_state, bx, by)
             gsum = (
                 grads
                 if gsum is None
@@ -245,6 +401,7 @@ def _make_comm_step(
     mesh: Mesh,
     augment: Optional[Callable],
     comm,
+    fused=None,
 ) -> Callable:
     """Explicit-collective DP train step (comm= on make_train_step).
 
@@ -280,9 +437,32 @@ def _make_comm_step(
     use_ring = comm.impl == "ring"
     overlap = use_ring and comm.overlap and accum_steps > 1
 
-    def loss_fn(params, model_state, x, y):
-        logits, new_state = model.apply(params, model_state, x, train=True)
-        return cross_entropy(logits, y), new_state
+    loss_fn = _build_loss_fn(model, fused)
+    scale = (
+        float(fused.loss_scale)
+        if fused is not None and fused.act_dtype == "bfloat16"
+        else 1.0
+    )
+
+    def grad_fn(params, model_state, bx, by):
+        # Static loss scaling for the bf16 path — same discipline as
+        # make_train_step's grad_fn (exact power-of-two unscale per
+        # microbatch, accumulation in the unscaled domain).
+        if scale == 1.0:
+            (loss, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, model_state, bx, by)
+            return loss, new_state, grads
+
+        def scaled(params, model_state, bx, by):
+            loss, new_state = loss_fn(params, model_state, bx, by)
+            return loss * scale, (loss, new_state)
+
+        grads, (loss, new_state) = jax.grad(scaled, has_aux=True)(
+            params, model_state, bx, by
+        )
+        grads = jax.tree_util.tree_map(lambda g: g * (1.0 / scale), grads)
+        return loss, new_state, grads
 
     def shard_body(state: ZooState, x, y, key_data=None):
         params, model_state = state.params, state.model_state
@@ -320,9 +500,7 @@ def _make_comm_step(
                     bx, gsum, lsum, model_state = jax.lax.optimization_barrier(
                         (bx, gsum, lsum, model_state)
                     )
-            (loss, model_state), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(params, model_state, bx, by)
+            loss, model_state, grads = grad_fn(params, model_state, bx, by)
             lsum = lsum + loss
             if overlap:
                 if plan is None:
@@ -387,6 +565,208 @@ def _make_comm_step(
     else:
         sharded = shard_map(
             shard_body, in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)), **specs
+        )
+
+        def step(state: ZooState, x, y, key=None):
+            return sharded(state, x, y)
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def make_fused_train_step(
+    model: Module,
+    *,
+    lr: float,
+    momentum: float,
+    accum_steps: int,
+    mesh: Mesh,
+    augment: Optional[Callable],
+    comm,
+    fused,
+    n_buckets: int,
+) -> Callable:
+    """Update-on-arrival train step (round 7): the optimizer disappears
+    into the collective schedule.
+
+    Extends _make_comm_step's overlap path (ring RS per microbatch,
+    sharded accumulator) past the gradient: when the LAST microbatch's
+    reduce-scatter lands, each device holds the fully-summed gradient
+    shard of every bucket — so instead of all-gathering gradients and
+    running a tree-wide optax pass behind the barrier, bucket b's
+    param+momentum shard update (ops.pallas_update.fused_sgd_momentum,
+    ZeRO-2 style: each device owns 1/n of params' update work) launches
+    the moment ITS sum is final, overlapped with the other buckets'
+    in-flight collectives, and the final all-gather ships already-UPDATED
+    parameter shards. Same wire volume as the gradient all-gather it
+    replaces — but the parameter AG always rides f32, regardless of
+    comm.wire_dtype: quantizing it would corrupt the f32 masters, while
+    the gradient RS tolerates bf16 wire (f32 accumulation, documented
+    error bound).
+
+    Dynamic loss scaling (fused.act_dtype="bfloat16"): the loss is scaled
+    by the TRACED scale riding in FusedOptState; after the last RS each
+    device checks its gradient shards for non-finites and a pmin agrees
+    globally. On overflow every shard update is dropped via jnp.where
+    (params, momentum, and BN stats stay bit-identical — a skipped step,
+    not a rollback) and the scale backs off by ``fused.backoff``
+    (clamped ≥1); after ``fused.growth_interval`` clean steps it doubles.
+    The unscale multiplier 1/(scale·accum·n_data) folds loss-scale,
+    accumulation, and device count into the fused kernel's single scalar
+    operand. The resilience sentinel reads the skip counter via
+    Sentinel.check_scaled so a handled overflow reports healthy.
+
+    Supports constant-LR SGD(+momentum) — lr/momentum are baked into the
+    kernels as static scalars; train() rejects schedules/weight-decay on
+    this path.
+    """
+    from parallel_cnn_tpu.ops import pallas_update
+    from parallel_cnn_tpu.parallel import collectives
+    from parallel_cnn_tpu.parallel.mesh import shard_map
+
+    if comm is None or comm.impl != "ring":
+        raise ValueError(
+            "update-on-arrival requires comm.impl='ring' (the bucketed "
+            "reduce-scatter is what produces the per-device shards)"
+        )
+    n_data = mesh.shape[DATA_AXIS]
+    wire = collectives.wire_dtype_arg(comm)
+    loss_fn = _build_loss_fn(model, fused)
+    dynamic = fused.act_dtype == "bfloat16"
+
+    def shard_body(state: ZooState, x, y, key_data=None):
+        params, model_state = state.params, state.model_state
+        opt = state.opt_state
+        scale = opt.scale
+        if augment is not None:
+            key = jax.random.wrap_key_data(key_data)
+            key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
+            x = augment(key, x)
+        if x.shape[0] % accum_steps:
+            raise ValueError(
+                f"per-device batch {x.shape[0]} must be a multiple of "
+                f"accum_steps {accum_steps} (no silent sample dropping)"
+            )
+        mb = x.shape[0] // accum_steps
+
+        def scaled(params, model_state, bx, by):
+            loss, new_state = loss_fn(params, model_state, bx, by)
+            return loss * scale, (loss, new_state)
+
+        lsum = jnp.float32(0.0)
+        shard_acc = None
+        plan = None
+        for i in range(accum_steps):
+            bx = x[i * mb : (i + 1) * mb]
+            by = y[i * mb : (i + 1) * mb]
+            if i:
+                # shard_acc stays OUT of the barrier, exactly as in
+                # _make_comm_step's overlap schedule: the in-flight
+                # reduce-scatters must overlap this microbatch's compute.
+                bx, lsum, model_state = jax.lax.optimization_barrier(
+                    (bx, lsum, model_state)
+                )
+            grads, (loss, model_state) = jax.grad(scaled, has_aux=True)(
+                params, model_state, bx, by
+            )
+            lsum = lsum + loss  # UNSCALED loss for reporting
+            if plan is None:
+                plan = collectives.plan_buckets(
+                    grads, comm.bucket_bytes, shards=n_data
+                )
+            shards = collectives.reduce_scatter_buckets(
+                collectives.flatten_buckets(grads, plan),
+                DATA_AXIS, n_data, wire,
+            )
+            shard_acc = (
+                shards
+                if shard_acc is None
+                else [a + b for a, b in zip(shard_acc, shards)]
+            )
+        # Overflow check on the SHARDS (1/n of the gradient bytes), with
+        # one pmin to agree globally — every device must take the same
+        # apply-vs-skip branch or params would diverge across the ring.
+        finite = jnp.stack(
+            [jnp.all(jnp.isfinite(s)) for s in shard_acc]
+        ).all()
+        ok = jax.lax.pmin(finite.astype(jnp.int32), DATA_AXIS) > 0
+        gscale = 1.0 / (scale * (accum_steps * n_data))
+        idx = jax.lax.axis_index(DATA_AXIS)
+        pbuckets = collectives.flatten_buckets(params, plan)
+        new_pb = []
+        new_mom = []
+        for b, gsh in enumerate(shard_acc):
+            psh = jnp.take(
+                pbuckets[b].reshape(n_data, -1), idx, axis=0
+            )
+            msh = opt.mom[b][0]  # sharded in: local (1, L) row
+            p_new, m_new = pallas_update.fused_sgd_momentum(
+                psh, msh, gsh, lr=lr, momentum=momentum, scale=gscale
+            )
+            p_new = jnp.where(ok, p_new, psh)
+            m_new = jnp.where(ok, m_new, msh)
+            new_mom.append(m_new[None, :])
+            # Param all-gather: ALWAYS f32 wire (master precision).
+            new_pb.append(
+                collectives.ring_all_gather(p_new, DATA_AXIS, n_data, None)
+            )
+        params = collectives.unflatten_buckets(new_pb, plan)
+        new_state = jax.lax.pmean(model_state, DATA_AXIS)
+        model_state = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(ok, new, old),
+            new_state, state.model_state,
+        )
+        loss = jax.lax.pmean(lsum / accum_steps, DATA_AXIS)
+        if dynamic:
+            new_scale = jnp.where(
+                ok, scale, jnp.maximum(scale * fused.backoff, 1.0)
+            )
+            good = jnp.where(ok, opt.good_steps + 1, 0)
+            grow = good >= fused.growth_interval
+            new_scale = jnp.where(grow, new_scale * 2.0, new_scale)
+            good = jnp.where(grow, jnp.int32(0), good)
+        else:
+            new_scale, good = scale, opt.good_steps
+        skipped = opt.skipped + (1 - ok.astype(jnp.int32))
+        opt = FusedOptState(
+            mom=new_mom, scale=new_scale, good_steps=good, skipped=skipped
+        )
+        return ZooState(params, model_state, opt), loss
+
+    state_spec = ZooState(
+        params=P(),
+        model_state=P(),
+        opt_state=FusedOptState(
+            mom=[P(DATA_AXIS)] * n_buckets,
+            scale=P(),
+            good_steps=P(),
+            skipped=P(),
+        ),
+    )
+    specs = dict(
+        mesh=mesh,
+        out_specs=(state_spec, P()),
+        check_vma=False,  # ppermute outputs, as in _make_comm_step
+    )
+    if augment is not None:
+        sharded = shard_map(
+            shard_body,
+            in_specs=(state_spec, P(DATA_AXIS), P(DATA_AXIS), P()),
+            **specs,
+        )
+
+        def step(state: ZooState, x, y, key=None):
+            if key is None:
+                raise ValueError(
+                    "this train step was built with `augment`; call it as "
+                    "step(state, x, y, key) with a fresh PRNG key per step"
+                )
+            return sharded(state, x, y, jax.random.key_data(key))
+
+    else:
+        sharded = shard_map(
+            shard_body,
+            in_specs=(state_spec, P(DATA_AXIS), P(DATA_AXIS)),
+            **specs,
         )
 
         def step(state: ZooState, x, y, key=None):
@@ -480,6 +860,7 @@ def train(
     mesh: Optional[Mesh] = None,
     model_axis: bool = False,
     comm=None,
+    fused=None,
     seed: int = 0,
     verbose: bool = True,
     eval_data: Optional[Tuple[Any, Any]] = None,
@@ -542,6 +923,17 @@ def train(
       optional bf16 wire and microbatch overlap; see _make_comm_step for
       the (documented) BatchNorm batch-stat semantics delta vs GSPMD.
 
+    - ``fused`` (a config.FusedStepConfig): the round-7 fused training
+      step. ``fused.tail`` routes a recognized model head through the
+      fused pool→FC→softmax-CE kernel; ``act_dtype="bfloat16"`` runs
+      bf16 activations over f32 masters with loss scaling; and
+      ``fused.update`` dispatches to make_fused_train_step —
+      update-on-arrival over the ring collectives (requires ``mesh`` +
+      ``comm.impl="ring"``, constant-LR SGD(+momentum); degrades to
+      update=False with a note when the comm prerequisites are absent).
+      Under fused.update the sentinel treats an in-step loss-scale skip
+      as handled (Sentinel.check_scaled), not as a divergence.
+
     - ``resilience`` (a config.ResilienceConfig): health-sentinel policy
       over the epoch loss and params — and, when ``check_every_steps``
       is set, every N optimizer steps (each check is a host sync; the
@@ -566,12 +958,39 @@ def train(
             f"dataset of {images.shape[0]} samples yields zero batches "
             f"of {batch_size}"
         )
-    optimizer = make_optimizer(
-        lr, momentum, weight_decay,
-        schedule=lr_schedule, warmup_steps=warmup_steps,
-        total_steps=steps * epochs if lr_schedule == "cosine" else None,
-    )
-    state = init_state(model, jax.random.key(seed), in_shape, optimizer)
+    if fused is not None and fused.update:
+        if mesh is None or comm is None or comm.impl != "ring":
+            if verbose:
+                print(
+                    "fused-step: update-on-arrival needs mesh + "
+                    "comm.impl='ring'; falling back to fused tail only"
+                )
+            fused = dataclasses.replace(fused, update=False)
+        elif model_axis:
+            raise ValueError(
+                "fused.update is the explicit data-parallel path; "
+                "model_axis stays on GSPMD (set update=False)"
+            )
+        elif lr_schedule != "constant" or warmup_steps or weight_decay:
+            raise ValueError(
+                "fused.update supports constant-LR SGD(+momentum) only — "
+                "lr schedules/warmup/weight decay need the optax path "
+                "(set update=False)"
+            )
+    use_fused_update = fused is not None and fused.update
+    if use_fused_update:
+        state, n_buckets = init_fused_state(
+            model, jax.random.key(seed), in_shape,
+            n_data=mesh.shape[DATA_AXIS], fused=fused,
+            bucket_bytes=comm.bucket_bytes,
+        )
+    else:
+        optimizer = make_optimizer(
+            lr, momentum, weight_decay,
+            schedule=lr_schedule, warmup_steps=warmup_steps,
+            total_steps=steps * epochs if lr_schedule == "cosine" else None,
+        )
+        state = init_state(model, jax.random.key(seed), in_shape, optimizer)
     aug_fn = None
     if augment:
         from parallel_cnn_tpu.data import augment as aug_lib
@@ -579,10 +998,17 @@ def train(
         def aug_fn(key, x):
             return aug_lib.random_crop_flip(key, x, pad=augment_pad)
 
-    step = make_train_step(
-        model, optimizer, accum_steps, mesh, aug_fn, model_axis=model_axis,
-        comm=comm,
-    )
+    if use_fused_update:
+        step = make_fused_train_step(
+            model, lr=lr, momentum=momentum, accum_steps=accum_steps,
+            mesh=mesh, augment=aug_fn, comm=comm, fused=fused,
+            n_buckets=n_buckets,
+        )
+    else:
+        step = make_train_step(
+            model, optimizer, accum_steps, mesh, aug_fn,
+            model_axis=model_axis, comm=comm, fused=fused,
+        )
     ev_step = make_eval_step(model) if eval_data is not None else None
 
     from parallel_cnn_tpu.resilience import preempt
@@ -595,6 +1021,30 @@ def train(
 
     res = resilience
     sentinel = Sentinel() if res is not None and res.policy != "off" else None
+    _skip_seen = (
+        int(state.opt_state.skipped)
+        if isinstance(state.opt_state, FusedOptState)
+        else 0
+    )
+
+    def health_check(loss_val, st):
+        # Under the fused dynamic-loss-scale step, an overflow the step
+        # already absorbed (skip counter advanced, masters finite) is
+        # healthy — route through check_scaled instead of check.
+        nonlocal _skip_seen
+        if isinstance(st.opt_state, FusedOptState):
+            sk = int(st.opt_state.skipped)
+            v = sentinel.check_scaled(
+                loss=loss_val, params=st.params,
+                skipped_before=_skip_seen, skipped_now=sk,
+                scale=float(st.opt_state.scale),
+            )
+            _skip_seen = sk
+            if v.healthy and v.reason and verbose:
+                print(f"sentinel: {v.reason}")
+            return v
+        return sentinel.check(loss=loss_val, params=st.params)
+
     controller = None
     if sentinel is not None and res.policy == "rollback":
         controller = RollbackController(max_rollbacks=res.max_rollbacks)
@@ -671,9 +1121,7 @@ def train(
                 and res.check_every_steps
                 and (i + 1) % res.check_every_steps == 0
             ):
-                verdict = sentinel.check(
-                    loss=float(loss), params=state.params
-                )
+                verdict = health_check(float(loss), state)
                 if not verdict.healthy:
                     diverged = f"step {i} of epoch {epoch + 1}: " + (
                         verdict.reason
@@ -681,7 +1129,7 @@ def train(
                     break
         mean_loss = float(epoch_loss) / max(steps, 1)
         if diverged is None and sentinel is not None:
-            verdict = sentinel.check(loss=mean_loss, params=state.params)
+            verdict = health_check(mean_loss, state)
             if not verdict.healthy:
                 diverged = f"epoch {epoch + 1}: {verdict.reason}"
         if diverged is not None:

@@ -80,3 +80,9 @@ def pytest_configure(config):
         "serve: inference-serving tests (serve/ — bucket padding parity, "
         "AOT cache accounting, batcher backpressure/deadlines, loadgen)",
     )
+    config.addinivalue_line(
+        "markers",
+        "fused_step: fused training-step tests (ops/pallas_update.py, "
+        "ops/pallas_tail.py, update-on-arrival zoo step, bf16 loss "
+        "scaling — CPU interpret-mode safe)",
+    )
