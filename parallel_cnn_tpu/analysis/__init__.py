@@ -1,0 +1,39 @@
+"""graftcheck: JAX-aware static analysis & invariant verification.
+
+Four analyzer families, run via ``python -m parallel_cnn_tpu check``:
+
+- jaxpr analyzers (``jaxpr_rules``): trace the real train/serve entry
+  points abstractly and verify donation safety, collective discipline
+  (mesh axes, ring permutation cycles, f32 param wire) and
+  retrace hazards (weak types, captured python scalars).
+- AST lint rules (``ast_rules``): source-level rules over the package
+  (no wall-clock/random inside jit, env reads only in config.py,
+  no mutation of captured trees, env-var/doc parity, doc cross-refs).
+- Pallas budget verifier (``pallas_budget``): evaluates the `_pick_bb`
+  VMEM model for every registered kernel configuration at lint time.
+- Concurrency lint + race harness (``concurrency``): lock-discipline
+  checking for threaded modules plus a seeded deterministic stress
+  test asserting ServeStats counter conservation.
+
+Findings are structured :class:`~.diagnostics.Diagnostic` records with
+``file:line``, severity, and a ratchet baseline (``baseline.json``):
+pre-existing violations gate at "no new", new code gates at zero.
+Deliberate violations carry inline waivers::
+
+    something_unusual()  # graftcheck: disable=rule-name -- reason why
+"""
+
+from parallel_cnn_tpu.analysis.diagnostics import (  # noqa: F401
+    Diagnostic,
+    Severity,
+    load_baseline,
+    ratchet,
+    render_report,
+)
+
+
+def run_check(*args, **kwargs):
+    """Lazy forwarder: the checker pulls in jax-heavy analyzers."""
+    from parallel_cnn_tpu.analysis.checker import run_check as _run
+
+    return _run(*args, **kwargs)

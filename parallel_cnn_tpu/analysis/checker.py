@@ -1,0 +1,192 @@
+"""graftcheck orchestrator: run the analyzer families, apply waivers
+and the ratchet baseline, render the report, pick the exit code.
+
+Used three ways:
+
+- CLI: ``python -m parallel_cnn_tpu check`` (cli.py dispatch).
+- Dryrun: ``__graft_entry__`` runs a fast clean-tree leg (must exit 0)
+  and a seeded-violation tempfile leg (must exit nonzero).
+- Tests: ``tests/test_analysis.py`` calls :func:`run_check` /
+  individual families directly.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from parallel_cnn_tpu.analysis.diagnostics import (
+    DEFAULT_BASELINE,
+    Diagnostic,
+    REPO_ROOT,
+    Severity,
+    Waiver,
+    apply_waivers,
+    load_baseline,
+    parse_waivers,
+    ratchet,
+    relpath,
+    render_report,
+    save_baseline,
+)
+
+PACKAGE_DIR = REPO_ROOT / "parallel_cnn_tpu"
+
+# Live documentation set for parity/xref rules.  Historical round
+# summaries and bench archives under docs/ are frozen evidence records —
+# deliberately out of scope (they describe the tree as it WAS).
+LIVE_DOCS = (
+    "README.md",
+    "docs/api.md",
+    "docs/serving.md",
+    "docs/collectives.md",
+    "docs/fault_tolerance.md",
+    "docs/kernel_authoring.md",
+    "docs/static_analysis.md",
+    "docs/future_work.md",
+)
+
+# Host-side drivers included in the env-var scan (they read PCNN_* too).
+ENV_SCAN_DRIVERS = ("bench.py", "__graft_entry__.py")
+
+PARSER_FILES = ("parallel_cnn_tpu/cli.py", "bench.py", "benches/run.py",
+                "benches/watch.py", "parallel_cnn_tpu/analysis/checker.py")
+
+
+def _package_files() -> List[Path]:
+    return sorted(p for p in PACKAGE_DIR.rglob("*.py"))
+
+
+def _existing(rel_paths: Sequence[str]) -> List[Path]:
+    return [REPO_ROOT / r for r in rel_paths if (REPO_ROOT / r).exists()]
+
+
+def run_check(
+    fast: bool = False,
+    paths: Optional[Sequence[str]] = None,
+    baseline_path: Optional[Path] = None,
+    update_baseline: bool = False,
+    verbose: bool = False,
+    race_seeds: Tuple[int, ...] = (0, 1),
+) -> Tuple[int, str, List[Diagnostic]]:
+    """Run graftcheck; returns (exit_code, report, diagnostics).
+
+    ``paths`` switches to targeted mode: ONLY the AST + concurrency
+    families over exactly those files (no repo-level parity/xref, no
+    jaxpr traces, no Pallas budget, no race harness) — the mode the
+    seeded-violation dryrun leg and the rule fixtures use.
+    ``fast`` keeps all families but trims the expensive configurations
+    (zoo traces, deep model budgets, single race seed).
+    """
+    from parallel_cnn_tpu.analysis import ast_rules, concurrency
+
+    diags: List[Diagnostic] = []
+    waivers_by_file: Dict[str, List[Waiver]] = {}
+
+    targeted = paths is not None
+    py_files = (
+        [Path(p).resolve() for p in paths] if targeted else _package_files()
+    )
+
+    for p in py_files:
+        rel = relpath(p)
+        try:
+            source = p.read_text()
+            tree = ast.parse(source)
+        except OSError as e:
+            diags.append(Diagnostic(
+                rule="parse", severity=Severity.ERROR, file=rel, line=0,
+                message=f"cannot read: {e}",
+            ))
+            continue
+        except SyntaxError as e:
+            diags.append(Diagnostic(
+                rule="parse", severity=Severity.ERROR, file=rel,
+                line=e.lineno or 0, message=f"syntax error: {e.msg}",
+            ))
+            continue
+        waivers_by_file[rel] = parse_waivers(source)
+        diags.extend(ast_rules.scan_module(p, tree, source))
+        diags.extend(concurrency.scan_concurrency(p, tree))
+
+    if not targeted:
+        doc_files = _existing(LIVE_DOCS)
+        for p in doc_files:
+            waivers_by_file[relpath(p)] = parse_waivers(p.read_text())
+        env_code_files = (
+            _package_files()
+            + _existing(ENV_SCAN_DRIVERS)
+            + sorted((REPO_ROOT / "benches").glob("*.py"))
+        )
+        diags.extend(ast_rules.env_doc_parity(env_code_files, doc_files))
+        diags.extend(ast_rules.doc_xref(
+            doc_files,
+            _existing(PARSER_FILES),
+            REPO_ROOT / "benches" / "run.py",
+        ))
+
+        from parallel_cnn_tpu.analysis import jaxpr_rules, pallas_budget
+
+        diags.extend(jaxpr_rules.run_jaxpr_rules(fast=fast))
+        diags.extend(pallas_budget.run_pallas_budget(fast=fast))
+        seeds = race_seeds[:1] if fast else race_seeds
+        diags.extend(concurrency.run_race_checks(seeds=seeds))
+
+    diags = apply_waivers(diags, waivers_by_file)
+    baseline = load_baseline(baseline_path)
+    diags = ratchet(diags, baseline)
+
+    if update_baseline:
+        out = save_baseline(diags, baseline_path)
+        # Re-ratchet against what was just written so the exit code
+        # reflects the new baseline.
+        for d in diags:
+            d.baselined = False
+        diags = ratchet(diags, load_baseline(out))
+
+    report = render_report(diags, verbose=verbose)
+    exit_code = 1 if any(d.gates() for d in diags) else 0
+    return exit_code, report, diags
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """Entry point behind ``python -m parallel_cnn_tpu check``."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="parallel_cnn_tpu check",
+        description="graftcheck: JAX-aware static analysis "
+                    "(jaxpr invariants, AST lint, Pallas VMEM budgets, "
+                    "concurrency). Exit 0 = clean modulo baseline.",
+    )
+    ap.add_argument("--fast", action="store_true",
+                    help="trim expensive configurations (zoo traces, deep "
+                         "model budgets); the dryrun leg uses this")
+    ap.add_argument("--paths", nargs="+", metavar="FILE",
+                    help="targeted mode: lint ONLY these python files with "
+                         "the AST/concurrency families")
+    ap.add_argument("--baseline", type=Path, default=None,
+                    help=f"ratchet baseline file (default {DEFAULT_BASELINE})")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="accept current unwaived errors into the baseline")
+    ap.add_argument("--json", type=Path, default=None, metavar="PATH",
+                    help="also write diagnostics as JSON")
+    ap.add_argument("--verbose", "-v", action="store_true",
+                    help="include baselined and waived findings in the report")
+    args = ap.parse_args(argv)
+
+    code, report, diags = run_check(
+        fast=args.fast,
+        paths=args.paths,
+        baseline_path=args.baseline,
+        update_baseline=args.update_baseline,
+        verbose=args.verbose,
+    )
+    if args.json:
+        args.json.write_text(
+            json.dumps([d.to_json() for d in diags], indent=2) + "\n"
+        )
+    print(report)
+    return code

@@ -1,0 +1,424 @@
+"""Concurrency lint + deterministic race harness for the serving stack.
+
+Static side (:func:`scan_concurrency`), applied to package modules that
+import ``threading``:
+
+- ``lock-discipline``: in a class that owns a lock (``self._lock =
+  threading.Lock()`` in ``__init__``) or that spawns threads, a
+  read-modify-write on shared instance state (``self.x += 1``,
+  ``self.stats.d[k] = v``) outside a ``with self._lock:`` block is an
+  error; a plain attribute store outside the lock is a warning
+  (atomic in CPython, but publication-order still unguarded).
+  ``__init__`` is exempt — the object is not yet shared.
+- ``global-mutation``: mutating a module-level dict/list/set literal
+  from function bodies in a threading-importing module.  Deliberate
+  single-thread-discipline state (resilience/preempt.py's handler
+  registry) carries waivers.
+
+Dynamic side (:func:`run_race_harness`): a seeded N-thread stress test
+driving ``DynamicBatcher.submit`` through a jax-free stub pool —
+overload sheds, sub-millisecond deadlines, poisoned batches — then
+asserts *interleaving-independent* counter conservation on the shared
+``ServeStats``:
+
+    submitted == completed + shed + expired + failed
+
+plus client-observed outcome counts matching the server's counters and
+the latency histogram matching ``completed``.  Any dropped or
+double-counted increment (the exact bug an unguarded ``+= 1`` causes
+under contention) breaks one of these identities.
+"""
+
+from __future__ import annotations
+
+import ast
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from parallel_cnn_tpu.analysis.diagnostics import Diagnostic, Severity, relpath
+
+# ---------------------------------------------------------------------------
+# Static lock-discipline lint
+# ---------------------------------------------------------------------------
+
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "Lock", "RLock",
+}
+
+
+def _imports_threading(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name == "threading" for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "threading":
+                return True
+    return False
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _class_lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    """self.<attr> names assigned a Lock/RLock in __init__."""
+    locks: Set[str] = set()
+    for node in cls.body:
+        if isinstance(node, ast.FunctionDef) and node.name == "__init__":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call):
+                    if _dotted(sub.value.func) in _LOCK_CTORS:
+                        for t in sub.targets:
+                            if (
+                                isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"
+                            ):
+                                locks.add(t.attr)
+    return locks
+
+
+def _spawns_threads(cls: ast.ClassDef) -> bool:
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call) and _dotted(node.func) in (
+            "threading.Thread", "Thread",
+        ):
+            return True
+    return False
+
+
+def _self_rooted(node: ast.AST) -> bool:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return isinstance(node, ast.Name) and node.id == "self"
+
+
+def _scan_method(
+    rel: str, cls_name: str, method: ast.FunctionDef, locks: Set[str]
+) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+
+    def visit(node: ast.AST, locked: bool) -> None:
+        if isinstance(node, ast.With):
+            holds = locked or any(
+                isinstance(item.context_expr, ast.Attribute)
+                and _self_rooted(item.context_expr)
+                and item.context_expr.attr in locks
+                for item in node.items
+            )
+            for child in node.body:
+                visit(child, holds)
+            return
+        if isinstance(node, ast.FunctionDef) and node is not method:
+            return  # nested defs get their own discipline
+        if not locked:
+            if isinstance(node, ast.AugAssign) and _self_rooted(node.target):
+                diags.append(Diagnostic(
+                    rule="lock-discipline",
+                    severity=Severity.ERROR,
+                    file=rel,
+                    line=node.lineno,
+                    message=f"read-modify-write on shared state in "
+                            f"{cls_name}.{method.name} outside the owning "
+                            "lock; concurrent increments can be lost",
+                ))
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) and _self_rooted(t):
+                        diags.append(Diagnostic(
+                            rule="lock-discipline",
+                            severity=Severity.ERROR,
+                            file=rel,
+                            line=node.lineno,
+                            message=f"container write on shared state in "
+                                    f"{cls_name}.{method.name} outside the "
+                                    "owning lock; dict/list mutation is not "
+                                    "atomic under contention",
+                        ))
+                    elif (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        diags.append(Diagnostic(
+                            rule="lock-discipline",
+                            severity=Severity.WARNING,
+                            file=rel,
+                            line=node.lineno,
+                            message=f"attribute store 'self.{t.attr}' in "
+                                    f"{cls_name}.{method.name} outside the "
+                                    "owning lock (publication order "
+                                    "unguarded)",
+                        ))
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.Lambda)):
+                continue
+            visit(child, locked)
+
+    for stmt in method.body:
+        visit(stmt, False)
+    return diags
+
+
+def _module_global_containers(tree: ast.Module) -> Set[str]:
+    names: Set[str] = set()
+    for node in tree.body:
+        targets: List[ast.AST] = []
+        value: Optional[ast.AST] = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        if value is not None and isinstance(value, (ast.Dict, ast.List, ast.Set)):
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+_CONTAINER_MUTATORS = {
+    "append", "extend", "insert", "pop", "popitem", "remove", "clear",
+    "update", "setdefault", "add", "discard",
+}
+
+
+def _scan_global_mutation(rel: str, tree: ast.Module) -> List[Diagnostic]:
+    globals_ = _module_global_containers(tree)
+    if not globals_:
+        return []
+    diags: List[Diagnostic] = []
+    for fd in ast.walk(tree):
+        if not isinstance(fd, ast.FunctionDef):
+            continue
+        for node in ast.walk(fd):
+            hit: Optional[Tuple[int, str]] = None
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Subscript) and isinstance(t.value, ast.Name) \
+                            and t.value.id in globals_:
+                        hit = (node.lineno, t.value.id)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) and isinstance(t.value, ast.Name) \
+                            and t.value.id in globals_:
+                        hit = (node.lineno, t.value.id)
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _CONTAINER_MUTATORS
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in globals_
+            ):
+                hit = (node.lineno, node.func.value.id)
+            if hit is not None:
+                diags.append(Diagnostic(
+                    rule="global-mutation",
+                    severity=Severity.ERROR,
+                    file=rel,
+                    line=hit[0],
+                    message=f"module-level container '{hit[1]}' mutated from "
+                            f"'{fd.name}' in a threading module without a "
+                            "lock; document the threading contract or guard it",
+                ))
+    return diags
+
+
+def scan_concurrency(path, tree: ast.Module) -> List[Diagnostic]:
+    """Lock-discipline + global-mutation lint for one module."""
+    if not _imports_threading(tree):
+        return []
+    rel = relpath(path)
+    diags: List[Diagnostic] = []
+    for cls in (n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)):
+        locks = _class_lock_attrs(cls)
+        if not locks and not _spawns_threads(cls):
+            continue
+        for method in (n for n in cls.body if isinstance(n, ast.FunctionDef)):
+            if method.name == "__init__":
+                continue  # not yet shared across threads
+            diags.extend(_scan_method(rel, cls.name, method, locks))
+    diags.extend(_scan_global_mutation(rel, tree))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# Deterministic race harness
+# ---------------------------------------------------------------------------
+
+class _StubEngine:
+    """bucket_for twin of serve.engine.Engine — no jax, no device."""
+
+    def bucket_for(self, n: int) -> int:
+        return max(1, 1 << (max(1, n) - 1).bit_length())
+
+
+class _StubPool:
+    """ReplicaPool stand-in: seeded jitter, poison-marker failures."""
+
+    def __init__(self, n_replicas: int = 2, max_batch: int = 8,
+                 seed: int = 0, jitter_ms: float = 0.2):
+        class _Handle:
+            in_shape = (4,)
+
+        self.handle = _Handle()
+        self.max_batch = max_batch
+        self.n_replicas = n_replicas
+        self.engines = [_StubEngine() for _ in range(n_replicas)]
+        self._rr = 0
+        self._rr_lock = threading.Lock()
+        self._rng = np.random.default_rng(seed)
+        self._rng_lock = threading.Lock()
+        self._jitter_s = jitter_ms / 1e3
+
+    def next_replica(self) -> int:
+        with self._rr_lock:
+            r = self._rr % self.n_replicas
+            self._rr += 1
+            return r
+
+    def predict(self, xs: np.ndarray, replica: Optional[int] = None):
+        with self._rng_lock:
+            dt = float(self._rng.uniform(0.0, self._jitter_s))
+        time.sleep(dt)
+        if (xs[:, 0] == -1.0).any():
+            raise RuntimeError("poisoned batch")
+        return xs * 2.0, replica
+
+
+def run_race_harness(
+    seed: int = 0,
+    n_threads: int = 8,
+    n_requests: int = 50,
+    queue_depth: int = 4,
+    poison_rate: float = 0.05,
+    expire_rate: float = 0.1,
+) -> Dict[str, int]:
+    """Drive submit/shed/expire/fail paths from N threads; assert
+    counter conservation on the shared ServeStats.
+
+    The workload is seeded (per-thread RNG streams derived from
+    ``seed``) so the request mix reproduces; the assertions are
+    interleaving-INDEPENDENT identities, so they hold for every legal
+    schedule and fail for any lost/doubled counter update.
+    Returns the final counters (also handy for reporting).
+    """
+    from parallel_cnn_tpu.serve.batcher import DynamicBatcher, Overloaded
+
+    pool = _StubPool(seed=seed)
+    batcher = DynamicBatcher(
+        pool, max_wait_ms=1.0, queue_depth=queue_depth, stats=None, start=True
+    )
+    stats = batcher.stats
+
+    client = {"shed": 0, "ok": 0, "expired": 0, "failed": 0}
+    client_lock = threading.Lock()
+    futures: List[object] = []
+    futures_lock = threading.Lock()
+
+    def worker(tid: int) -> None:
+        rng = np.random.default_rng((seed, tid))
+        for i in range(n_requests):
+            x = np.full((4,), float(tid * n_requests + i), np.float32)
+            if rng.uniform() < poison_rate:
+                x[0] = -1.0
+            deadline_ms = None
+            if rng.uniform() < expire_rate:
+                deadline_ms = 1e-3  # ~1µs: expires before any dispatch
+            try:
+                fut = batcher.submit(x, deadline_ms=deadline_ms)
+            except Overloaded:
+                with client_lock:
+                    client["shed"] += 1
+                time.sleep(float(rng.uniform(0.0, 2e-3)))  # backoff
+                continue
+            with futures_lock:
+                futures.append(fut)
+            if rng.uniform() < 0.3:
+                time.sleep(float(rng.uniform(0.0, 1e-3)))
+
+    threads = [
+        threading.Thread(target=worker, args=(t,), name=f"race-{t}")
+        for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    from parallel_cnn_tpu.serve.batcher import DeadlineExceeded
+
+    for fut in futures:
+        try:
+            fut.result(timeout=30)
+            client["ok"] += 1
+        except DeadlineExceeded:
+            client["expired"] += 1
+        except RuntimeError:
+            client["failed"] += 1
+    batcher.close()
+
+    snap = stats.snapshot()
+    total = n_threads * n_requests
+    assert snap["submitted"] == total, (
+        f"submitted {snap['submitted']} != {total}: submit counter lost "
+        "updates under contention"
+    )
+    accounted = (
+        snap["completed"] + snap["shed"] + snap["expired"] + snap["failed"]
+    )
+    assert accounted == total, (
+        f"conservation violated: completed {snap['completed']} + shed "
+        f"{snap['shed']} + expired {snap['expired']} + failed "
+        f"{snap['failed']} = {accounted} != submitted {total}"
+    )
+    for server_key, client_key in (
+        ("completed", "ok"), ("shed", "shed"),
+        ("expired", "expired"), ("failed", "failed"),
+    ):
+        assert snap[server_key] == client[client_key], (
+            f"server {server_key}={snap[server_key]} disagrees with "
+            f"client-observed {client_key}={client[client_key]}"
+        )
+    lat_count = snap["latency_ms"].get("count", 0)
+    assert lat_count == snap["completed"], (
+        f"latency histogram holds {lat_count} samples but completed="
+        f"{snap['completed']}"
+    )
+    return {
+        "submitted": snap["submitted"],
+        "completed": snap["completed"],
+        "shed": snap["shed"],
+        "expired": snap["expired"],
+        "failed": snap["failed"],
+        "batches": snap["batches"],
+    }
+
+
+def run_race_checks(seeds: Tuple[int, ...] = (0, 1)) -> List[Diagnostic]:
+    """Checker entry: run the harness for each seed; an assertion
+    failure becomes a diagnostic."""
+    diags: List[Diagnostic] = []
+    for seed in seeds:
+        try:
+            run_race_harness(seed=seed)
+        except AssertionError as e:
+            diags.append(Diagnostic(
+                rule="race-harness",
+                severity=Severity.ERROR,
+                file="parallel_cnn_tpu/serve/batcher.py",
+                line=0,
+                message=f"counter conservation violated (seed {seed}): {e}",
+            ))
+    return diags

@@ -1,0 +1,216 @@
+"""Structured diagnostics, inline waivers, and the ratchet baseline.
+
+A finding is a :class:`Diagnostic`; analyzers yield them and the
+checker applies two suppression layers before gating:
+
+1. **Inline waivers** — ``# graftcheck: disable=rule-a,rule-b -- reason``
+   on the flagged line (or on a line of its own immediately above it)
+   suppresses those rules at that site.  The reason string after
+   ``--`` is mandatory: a waiver without one is itself reported as a
+   ``bare-waiver`` error so suppressions stay auditable.
+2. **Ratchet baseline** — ``analysis/baseline.json`` records
+   fingerprints of accepted pre-existing findings.  A finding whose
+   fingerprint appears in the baseline is demoted to "baselined" and
+   does not gate; anything new gates at zero.  Fingerprints are
+   line-number-free (rule + file + normalized message) so unrelated
+   edits don't churn the baseline.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+# Severity ordering: only ERROR gates the exit code; WARNING is
+# informational (reported, counted, never fails the run).
+class Severity:
+    ERROR = "error"
+    WARNING = "warning"
+
+
+@dataclass
+class Diagnostic:
+    rule: str
+    severity: str
+    file: str            # repo-relative path (or "<repo>" for global rules)
+    line: int            # 1-based; 0 when the finding has no single line
+    message: str
+    waived: bool = False
+    waive_reason: str = ""
+    baselined: bool = False
+
+    def location(self) -> str:
+        return f"{self.file}:{self.line}" if self.line else self.file
+
+    def fingerprint(self) -> str:
+        # Line numbers excluded so edits above a finding don't churn
+        # the ratchet; volatile numbers in messages normalized too.
+        norm = re.sub(r"\b\d+\b", "#", self.message)
+        h = hashlib.sha256(f"{self.rule}|{self.file}|{norm}".encode()).hexdigest()
+        return f"{self.rule}|{self.file}|{h[:16]}"
+
+    def gates(self) -> bool:
+        return (
+            self.severity == Severity.ERROR
+            and not self.waived
+            and not self.baselined
+        )
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "file": self.file,
+            "line": self.line,
+            "message": self.message,
+            "waived": self.waived,
+            "waive_reason": self.waive_reason,
+            "baselined": self.baselined,
+        }
+
+
+def relpath(path: Path | str) -> str:
+    p = Path(path).resolve()
+    try:
+        return str(p.relative_to(REPO_ROOT))
+    except ValueError:
+        return str(p)
+
+
+# ---------------------------------------------------------------------------
+# Inline waivers
+# ---------------------------------------------------------------------------
+
+_WAIVER_RE = re.compile(
+    r"#\s*graftcheck:\s*disable=([A-Za-z0-9_,\- ]+?)\s*(?:--\s*(.*))?$"
+)
+
+
+@dataclass
+class Waiver:
+    rules: Tuple[str, ...]
+    reason: str
+    line: int            # the line the comment sits on (1-based)
+    standalone: bool     # comment-only line => applies to the next line
+
+
+def parse_waivers(source: str) -> List[Waiver]:
+    out: List[Waiver] = []
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _WAIVER_RE.search(text)
+        if not m:
+            continue
+        rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+        reason = (m.group(2) or "").strip()
+        standalone = text.strip().startswith("#")
+        out.append(Waiver(rules=rules, reason=reason, line=i, standalone=standalone))
+    return out
+
+
+def apply_waivers(
+    diags: Iterable[Diagnostic], waivers_by_file: Dict[str, List[Waiver]]
+) -> List[Diagnostic]:
+    """Mark diagnostics covered by an inline waiver; emit bare-waiver
+    errors for waivers missing a reason string."""
+    result = list(diags)
+    for diag in result:
+        for w in waivers_by_file.get(diag.file, []):
+            covered = diag.line == w.line or (w.standalone and diag.line == w.line + 1)
+            if covered and (diag.rule in w.rules or "all" in w.rules):
+                diag.waived = True
+                diag.waive_reason = w.reason
+                break
+    for file, waivers in waivers_by_file.items():
+        for w in waivers:
+            if not w.reason:
+                result.append(
+                    Diagnostic(
+                        rule="bare-waiver",
+                        severity=Severity.ERROR,
+                        file=file,
+                        line=w.line,
+                        message=(
+                            "waiver for %s has no reason string; write "
+                            "'# graftcheck: disable=<rule> -- <why>'"
+                            % ",".join(w.rules)
+                        ),
+                    )
+                )
+    return result
+
+
+# ---------------------------------------------------------------------------
+# Ratchet baseline
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: Optional[Path] = None) -> Dict[str, int]:
+    p = Path(path) if path else DEFAULT_BASELINE
+    if not p.exists():
+        return {}
+    data = json.loads(p.read_text())
+    return dict(data.get("entries", {}))
+
+
+def save_baseline(diags: Sequence[Diagnostic], path: Optional[Path] = None) -> Path:
+    p = Path(path) if path else DEFAULT_BASELINE
+    entries: Dict[str, int] = {}
+    for d in diags:
+        if d.severity == Severity.ERROR and not d.waived:
+            key = d.fingerprint()
+            entries[key] = entries.get(key, 0) + 1
+    p.write_text(
+        json.dumps({"version": 1, "entries": dict(sorted(entries.items()))}, indent=2)
+        + "\n"
+    )
+    return p
+
+
+def ratchet(diags: Iterable[Diagnostic], baseline: Dict[str, int]) -> List[Diagnostic]:
+    """Demote findings present in the baseline (count-aware: a baseline
+    entry with count N absorbs at most N identical findings, so adding
+    a second instance of a baselined violation still gates)."""
+    budget = dict(baseline)
+    out = list(diags)
+    for d in out:
+        if d.severity != Severity.ERROR or d.waived:
+            continue
+        key = d.fingerprint()
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            d.baselined = True
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Rendering
+# ---------------------------------------------------------------------------
+
+def render_report(diags: Sequence[Diagnostic], *, verbose: bool = False) -> str:
+    lines: List[str] = []
+    gating = [d for d in diags if d.gates()]
+    warnings = [d for d in diags if d.severity == Severity.WARNING and not d.waived]
+    waived = [d for d in diags if d.waived]
+    baselined = [d for d in diags if d.baselined]
+
+    for d in sorted(gating, key=lambda d: (d.file, d.line, d.rule)):
+        lines.append(f"{d.location()}: error[{d.rule}]: {d.message}")
+    for d in sorted(warnings, key=lambda d: (d.file, d.line, d.rule)):
+        lines.append(f"{d.location()}: warning[{d.rule}]: {d.message}")
+    if verbose:
+        for d in sorted(baselined, key=lambda d: (d.file, d.line, d.rule)):
+            lines.append(f"{d.location()}: baselined[{d.rule}]: {d.message}")
+        for d in sorted(waived, key=lambda d: (d.file, d.line, d.rule)):
+            reason = f" ({d.waive_reason})" if d.waive_reason else ""
+            lines.append(f"{d.location()}: waived[{d.rule}]{reason}: {d.message}")
+    lines.append(
+        "graftcheck: %d gating error(s), %d warning(s), %d baselined, %d waived"
+        % (len(gating), len(warnings), len(baselined), len(waived))
+    )
+    return "\n".join(lines)

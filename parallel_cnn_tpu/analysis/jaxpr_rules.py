@@ -1,0 +1,390 @@
+"""jaxpr-level invariant analyzers.
+
+The real train/serve entry points are traced abstractly (via
+``jax.make_jaxpr`` — no kernel runs, no device memory) and the resulting
+jaxprs are walked recursively (into pjit/scan/while/cond/shard_map
+sub-jaxprs) checking:
+
+- ``collective-axis``: every collective's axis name must exist on the
+  nearest enclosing ``shard_map`` mesh (or the declared ``data``/
+  ``model`` axes at top level).  A typo'd axis name surfaces at run
+  time as an unbound-axis error on device — here it's a lint failure.
+- ``ring-permutation``: every ``ppermute`` permutation must be a single
+  cycle covering all participants.  A broken ring (two sub-cycles, a
+  dropped rank) reduces only part of the gradient and silently
+  desynchronizes replicas — the exact class of bug arXiv:1810.11112's
+  scheduling constraints exist to prevent.
+- ``f32-wire`` (masters never ride bf16): any ``ppermute`` whose output
+  reaches a jaxpr output through *layout-only* ops (reshape, slice,
+  concatenate, dtype cast, …) is a param all-gather wire and must carry
+  float32.  Gradient wires may be bf16 — they pass through optimizer
+  arithmetic before reaching an output, which breaks the transparent
+  chain, so they are exempt by construction.
+- ``donated-reuse``: an operand donated to a pjit call may not be read
+  by any later equation — donation aliases the buffer to the output.
+- ``weak-type``: weak-typed entry arguments and 0-d weak constants
+  captured by the trace.  Weak types re-promote per call site and a
+  python scalar captured as a traced constant bakes its value into the
+  executable — both are retrace/staleness hazards.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, List, Optional, Sequence, Set, Tuple
+
+from parallel_cnn_tpu.analysis.diagnostics import Diagnostic, Severity
+
+# Declared mesh axes (parallel/mesh.py DATA_AXIS/MODEL_AXIS).
+DECLARED_AXES = {"data", "model"}
+
+# Primitives that only rearrange/retag values: a ppermute output flowing
+# through ONLY these to a jaxpr output means the wire dtype is what the
+# caller receives.  convert_element_type is deliberately transparent so
+# "gather bf16 then cast back to f32" is still caught — the precision
+# was already lost on the wire.
+_TRANSPARENT = {
+    "reshape", "squeeze", "expand_dims", "transpose", "rev", "slice",
+    "dynamic_slice", "dynamic_update_slice", "concatenate", "pad",
+    "broadcast_in_dim", "convert_element_type", "copy", "gather",
+    "scatter", "select_n",
+}
+
+# Primitives carrying a mesh-axis parameter worth checking.
+_AXIS_PARAM_KEYS = ("axis_name", "axes")
+
+
+def _axis_names(eqn) -> Tuple[str, ...]:
+    names: List[str] = []
+    for key in _AXIS_PARAM_KEYS:
+        if key in eqn.params:
+            v = eqn.params[key]
+            if isinstance(v, str):
+                names.append(v)
+            elif isinstance(v, (tuple, list)):
+                names.extend(x for x in v if isinstance(x, str))
+    return tuple(names)
+
+
+def _sub_jaxprs(eqn) -> Iterable:
+    """Inner jaxprs of an equation (pjit jaxpr, scan body, cond branches,
+    shard_map body, custom_vjp calls...)."""
+    for v in eqn.params.values():
+        vals = v if isinstance(v, (tuple, list)) else (v,)
+        for item in vals:
+            inner = getattr(item, "jaxpr", None)
+            if inner is not None and hasattr(inner, "eqns"):
+                yield inner          # ClosedJaxpr
+            elif hasattr(item, "eqns"):
+                yield item           # raw Jaxpr
+
+
+def walk_jaxpr(jaxpr, visit: Callable, allowed: Set[str]) -> None:
+    """Depth-first walk calling ``visit(jaxpr, eqn, allowed)``; the
+    allowed-axis set is refined at each shard_map from its mesh."""
+    for eqn in jaxpr.eqns:
+        visit(jaxpr, eqn, allowed)
+        sub_allowed = allowed
+        if eqn.primitive.name == "shard_map":
+            mesh = eqn.params.get("mesh")
+            axis_names = getattr(mesh, "axis_names", None)
+            if axis_names:
+                sub_allowed = set(axis_names)
+        for sub in _sub_jaxprs(eqn):
+            walk_jaxpr(sub, visit, sub_allowed)
+
+
+def _is_single_cycle(perm: Sequence[Tuple[int, int]]) -> bool:
+    if not perm:
+        return False
+    srcs = [s for s, _ in perm]
+    dsts = [d for _, d in perm]
+    members = set(srcs) | set(dsts)
+    if len(set(srcs)) != len(srcs) or len(set(dsts)) != len(dsts):
+        return False
+    if set(srcs) != members or set(dsts) != members:
+        return False
+    nxt = dict(perm)
+    start = srcs[0]
+    seen = set()
+    cur = start
+    while cur not in seen:
+        seen.add(cur)
+        cur = nxt[cur]
+    return cur == start and seen == members
+
+
+def _var_key(v) -> Optional[int]:
+    # Literals have no identity across uses; Vars do.
+    return id(v) if not hasattr(v, "val") else None
+
+
+def _wire_reachable_permutes(jaxpr):
+    """ppermute eqns whose outputs reach jaxpr outvars through
+    transparent ops only."""
+    producer = {}
+    for eqn in jaxpr.eqns:
+        for ov in eqn.outvars:
+            producer[_var_key(ov)] = eqn
+    hits = []
+    seen_eqns: Set[int] = set()
+    frontier = [v for v in jaxpr.outvars]
+    seen_vars: Set[int] = set()
+    while frontier:
+        v = frontier.pop()
+        k = _var_key(v)
+        if k is None or k in seen_vars:
+            continue
+        seen_vars.add(k)
+        eqn = producer.get(k)
+        if eqn is None or id(eqn) in seen_eqns:
+            continue
+        name = eqn.primitive.name
+        if name == "ppermute":
+            seen_eqns.add(id(eqn))
+            hits.append(eqn)
+            continue  # don't cross the wire
+        if name in _TRANSPARENT:
+            seen_eqns.add(id(eqn))
+            frontier.extend(eqn.invars)
+    return hits
+
+
+# ---------------------------------------------------------------------------
+# Rules over one traced entry point
+# ---------------------------------------------------------------------------
+
+def analyze_closed_jaxpr(name: str, closed) -> List[Diagnostic]:
+    """Run all jaxpr rules over one ClosedJaxpr.  ``name`` labels the
+    entry point; findings use the pseudo-file ``<jaxpr:name>``."""
+    diags: List[Diagnostic] = []
+    file = f"<jaxpr:{name}>"
+
+    def visit(jaxpr, eqn, allowed: Set[str]) -> None:
+        prim = eqn.primitive.name
+        for axis in _axis_names(eqn):
+            if axis not in allowed:
+                diags.append(Diagnostic(
+                    rule="collective-axis",
+                    severity=Severity.ERROR,
+                    file=file,
+                    line=0,
+                    message=f"{prim} uses axis '{axis}' which is not on the "
+                            f"enclosing mesh (axes: {sorted(allowed)})",
+                ))
+        if prim == "ppermute":
+            perm = list(eqn.params.get("perm", ()))
+            if not _is_single_cycle(perm):
+                diags.append(Diagnostic(
+                    rule="ring-permutation",
+                    severity=Severity.ERROR,
+                    file=file,
+                    line=0,
+                    message=f"ppermute permutation {perm} is not a single "
+                            "cycle over all participants; a broken ring "
+                            "reduces only part of the gradient",
+                ))
+        if "donated_invars" in eqn.params:
+            diags.extend(_donated_reuse(file, jaxpr, eqn))
+
+    walk_jaxpr(closed.jaxpr, visit, set(DECLARED_AXES))
+
+    # f32-wire: applied per sub-jaxpr so the "reaches an output through
+    # transparent ops" slice respects scope boundaries.
+    def wire_visit(jaxpr) -> None:
+        for eqn in _wire_reachable_permutes(jaxpr):
+            for ov in eqn.outvars:
+                dtype = getattr(ov.aval, "dtype", None)
+                if dtype is not None and str(dtype) not in ("float32", "float64"):
+                    diags.append(Diagnostic(
+                        rule="f32-wire",
+                        severity=Severity.ERROR,
+                        file=file,
+                        line=0,
+                        message=f"ppermute output ({dtype}) reaches a jaxpr "
+                                "output through layout-only ops: a param "
+                                "all-gather is riding a non-f32 wire — "
+                                "masters never ride bf16",
+                    ))
+
+    def _walk_all(jaxpr) -> None:
+        wire_visit(jaxpr)
+        for eqn in jaxpr.eqns:
+            for sub in _sub_jaxprs(eqn):
+                _walk_all(sub)
+
+    _walk_all(closed.jaxpr)
+
+    diags.extend(_weak_types(file, closed))
+    return diags
+
+
+def _donated_reuse(file: str, jaxpr, eqn) -> List[Diagnostic]:
+    flags = eqn.params.get("donated_invars") or ()
+    donated = {
+        _var_key(iv)
+        for iv, f in zip(eqn.invars, flags)
+        if f and _var_key(iv) is not None
+    }
+    if not donated:
+        return []
+    out: List[Diagnostic] = []
+    past = False
+    for later in jaxpr.eqns:
+        if later is eqn:
+            past = True
+            continue
+        if not past:
+            continue
+        for iv in later.invars:
+            if _var_key(iv) in donated:
+                out.append(Diagnostic(
+                    rule="donated-reuse",
+                    severity=Severity.ERROR,
+                    file=file,
+                    line=0,
+                    message=f"operand donated to '{eqn.params.get('name', 'pjit')}' "
+                            f"is read again by a later '{later.primitive.name}' "
+                            "equation; donation aliases the buffer to the output",
+                ))
+    for ov in jaxpr.outvars:
+        if _var_key(ov) in donated:
+            out.append(Diagnostic(
+                rule="donated-reuse",
+                severity=Severity.ERROR,
+                file=file,
+                line=0,
+                message="a donated operand is returned as a jaxpr output after "
+                        "donation; the caller would observe an aliased buffer",
+            ))
+    return out
+
+
+def _weak_types(file: str, closed) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    for i, v in enumerate(closed.jaxpr.invars):
+        aval = v.aval
+        if getattr(aval, "weak_type", False):
+            diags.append(Diagnostic(
+                rule="weak-type",
+                severity=Severity.ERROR,
+                file=file,
+                line=0,
+                message=f"entry argument {i} traces weak-typed ({aval}); a "
+                        "python scalar argument re-promotes per call site — "
+                        "pass a jnp array with an explicit dtype",
+            ))
+    for cv, val in zip(closed.jaxpr.constvars, closed.consts):
+        aval = cv.aval
+        if getattr(aval, "ndim", None) == 0 and getattr(aval, "weak_type", False):
+            diags.append(Diagnostic(
+                rule="weak-type",
+                severity=Severity.ERROR,
+                file=file,
+                line=0,
+                message=f"0-d weak-typed constant {val!r} captured by the "
+                        "trace; its value is frozen into the executable and "
+                        "its weak type re-promotes downstream dtypes",
+            ))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# Entry-point registry
+# ---------------------------------------------------------------------------
+
+def trace_entry_points(fast: bool = False) -> List[Tuple[str, object]]:
+    """Trace the real entry points abstractly; returns (name, ClosedJaxpr).
+
+    ``fast`` skips the zoo steps (the most expensive traces).  Zoo traces
+    also require a ≥2-device mesh; on a single device they are skipped.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from parallel_cnn_tpu.models import lenet_ref
+    from parallel_cnn_tpu.train import step
+
+    out: List[Tuple[str, object]] = []
+
+    lp = lenet_ref.init(jax.random.key(0))
+    lx = jnp.zeros((8, 28, 28), jnp.float32)
+    ly = jnp.zeros((8,), jnp.int32)
+    out.append((
+        "train.batched_step",
+        jax.make_jaxpr(lambda p, x, y: step.batched_step(p, x, y, 0.05))(
+            lp, lx, ly
+        ),
+    ))
+    out.append((
+        "train.fused_batched_step",
+        jax.make_jaxpr(
+            lambda p, x, y: step.fused_batched_step(p, x, y, 0.05)
+        )(lp, lx, ly),
+    ))
+
+    from parallel_cnn_tpu.serve import registry as serve_registry
+
+    sh = serve_registry.get("cifar_cnn")
+    sp, sst = sh.init(jax.random.key(0))
+    sx = jnp.zeros((4, *sh.in_shape), jnp.float32)
+    out.append((
+        "serve.engine_forward",
+        jax.make_jaxpr(lambda p, st, v: sh.forward(p, st, v))(sp, sst, sx),
+    ))
+
+    if fast:
+        return out
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        return out
+
+    from parallel_cnn_tpu.config import CommConfig, FusedStepConfig, MeshConfig
+    from parallel_cnn_tpu.nn import cifar
+    from parallel_cnn_tpu.parallel import mesh as mesh_lib
+    from parallel_cnn_tpu.train import zoo
+
+    mesh = mesh_lib.make_mesh(
+        MeshConfig(data=n_dev, model=1), devices=jax.devices()[:n_dev]
+    )
+    n_data = mesh.shape["data"]
+    model = cifar.cifar_cnn()
+    zx = jnp.zeros((2 * n_data, *cifar.IN_SHAPE), jnp.float32)
+    zy = jnp.zeros((2 * n_data,), jnp.int32)
+
+    with mesh:
+        ring_bf16 = CommConfig(impl="ring", wire_dtype="bfloat16")
+        opt = zoo.make_optimizer(0.01, momentum=0.9)
+        st = zoo.init_state(model, jax.random.key(1), cifar.IN_SHAPE, opt)
+        comm_step = zoo.make_train_step(
+            model, opt, accum_steps=2, mesh=mesh, comm=ring_bf16
+        )
+        out.append((
+            "zoo.comm_step.ring_bf16",
+            jax.make_jaxpr(comm_step)(st, zx, zy),
+        ))
+
+        # Sharpest wire check: activations AND gradient wire in bf16 —
+        # the param all-gather must STILL carry f32 masters.
+        fused = FusedStepConfig(update=True, tail=True, act_dtype="bfloat16")
+        fst, n_buckets = zoo.init_fused_state(
+            model, jax.random.key(1), cifar.IN_SHAPE,
+            n_data=n_data, fused=fused, bucket_bytes=ring_bf16.bucket_bytes,
+        )
+        fused_step = zoo.make_fused_train_step(
+            model, lr=0.01, momentum=0.9, accum_steps=2, mesh=mesh,
+            augment=None, comm=ring_bf16, fused=fused, n_buckets=n_buckets,
+        )
+        out.append((
+            "zoo.fused_step.ring_bf16",
+            jax.make_jaxpr(fused_step)(fst, zx, zy),
+        ))
+    return out
+
+
+def run_jaxpr_rules(fast: bool = False) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    for name, closed in trace_entry_points(fast=fast):
+        diags.extend(analyze_closed_jaxpr(name, closed))
+    return diags

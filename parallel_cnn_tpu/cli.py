@@ -350,8 +350,8 @@ def _run_serve(cmd: str, argv: List[str]) -> int:
 
     import jax
 
-    if os.environ.get("PCNN_JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["PCNN_JAX_PLATFORMS"])
+    if os.environ.get("PCNN_JAX_PLATFORMS"):  # graftcheck: disable=env-outside-config -- platform override must reach jax.config before backend init; tests/conftest.py documents why the env var alone is insufficient
+        jax.config.update("jax_platforms", os.environ["PCNN_JAX_PLATFORMS"])  # graftcheck: disable=env-outside-config -- platform override must reach jax.config before backend init; tests/conftest.py documents why the env var alone is insufficient
     import json as json_mod
     import time
 
@@ -423,6 +423,34 @@ def _run_serve(cmd: str, argv: List[str]) -> int:
     return 0
 
 
+def _run_check(argv: List[str]) -> int:
+    """`python -m parallel_cnn_tpu check` — graftcheck static analysis.
+
+    A host-side lint pass: it never needs (or touches) an accelerator,
+    so CPU is forced unconditionally, with 8 virtual devices so the
+    mesh-shaped jaxpr analyzers can trace the real collective schedules.
+    Both knobs must land before jax initializes a backend — hence the
+    env write here, first thing, mirroring tests/conftest.py (the
+    ambient plugin snapshots XLA_FLAGS at import)."""
+    flags = os.environ.get("XLA_FLAGS", "")  # graftcheck: disable=env-outside-config -- backend bootstrap, must precede jax import; not a tunable knob
+    if "xla_force_host_platform_device_count" not in flags:
+        # graftcheck: disable=env-outside-config -- backend bootstrap, must precede jax import; not a tunable knob
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # backend already initialized (embedded call): analyze as-is
+
+    from parallel_cnn_tpu.analysis import checker
+
+    return checker.main(argv)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import sys
 
@@ -433,6 +461,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     # unchanged (no retrofit of subparsers onto existing automation).
     if raw and raw[0] in ("serve", "loadgen"):
         return _run_serve(raw[0], raw[1:])
+    if raw and raw[0] == "check":
+        return _run_check(raw[1:])
     args = build_parser().parse_args(raw)
     cfg = config_from_args(args)
 
@@ -452,8 +482,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     # Reliable platform override: the ambient plugin snapshots JAX_PLATFORMS
     # before user code (tests/conftest.py documents this), so the env var
     # alone can't force CPU — jax.config.update can.
-    if os.environ.get("PCNN_JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["PCNN_JAX_PLATFORMS"])
+    if os.environ.get("PCNN_JAX_PLATFORMS"):  # graftcheck: disable=env-outside-config -- platform override must reach jax.config before backend init; tests/conftest.py documents why the env var alone is insufficient
+        jax.config.update("jax_platforms", os.environ["PCNN_JAX_PLATFORMS"])  # graftcheck: disable=env-outside-config -- platform override must reach jax.config before backend init; tests/conftest.py documents why the env var alone is insufficient
     import jax.numpy as jnp
 
     from parallel_cnn_tpu.data import pipeline

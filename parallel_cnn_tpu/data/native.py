@@ -31,7 +31,7 @@ from parallel_cnn_tpu.data.mnist import MnistError
 # Chaos/ops escape hatch: force the no-native fallback path without
 # touching the filesystem (resilience/chaos.py hidden_native_lib uses it
 # to prove pipeline.py's NumPy degradation deterministically).
-if os.environ.get("PCNN_DISABLE_NATIVE") == "1":
+if os.environ.get("PCNN_DISABLE_NATIVE") == "1":  # graftcheck: disable=env-outside-config -- chaos escape hatch evaluated at import, before any Config object exists
     raise ImportError("native runtime disabled via PCNN_DISABLE_NATIVE=1")
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir, "native")
@@ -112,7 +112,7 @@ def _load_lib_with_retry() -> ctypes.CDLL:
     from parallel_cnn_tpu.resilience.retry import RetryPolicy, retry_call
 
     policy = RetryPolicy(
-        attempts=int(os.environ.get("PCNN_NATIVE_RETRIES", "2")),
+        attempts=int(os.environ.get("PCNN_NATIVE_RETRIES", "2")),  # graftcheck: disable=env-outside-config -- loader-internal retry knob read at call time; no Config flows through the native boundary
         base_delay=0.1,
         max_delay=1.0,
     )

@@ -130,17 +130,17 @@ _VMEM_LIMIT = 100 * 1024 * 1024
 # second grid dimension walking cout tiles — the grid pipeline then
 # double-buffers the weight DMA (prefetch tile j+1 while j multiplies)
 # instead of holding the whole stack resident. 0 disables.
-_COUT_TILE = int(os.environ.get("PCNN_PALLAS_COUT_TILE", "256"))
+_COUT_TILE = int(os.environ.get("PCNN_PALLAS_COUT_TILE", "256"))  # graftcheck: disable=env-outside-config -- import-time tiling knob read once into a module constant
 
 # Row-band tiling threshold: per-image flat rows above this split into
 # H-bands, each its own kernel call (Mosaic compile time scales with
 # taps × rows; the 224² stem's 49 × 12880 was pathological). 6144 keeps
 # every ≤64² zoo shape single-band.
-_MAX_ROWS_PER_IMG = int(os.environ.get("PCNN_PALLAS_MAX_ROWS_PER_IMG",
+_MAX_ROWS_PER_IMG = int(os.environ.get("PCNN_PALLAS_MAX_ROWS_PER_IMG",  # graftcheck: disable=env-outside-config -- import-time tiling knob read once into a module constant
                                        "6144"))
 
 # Env-gated stem→XLA hybrid (see prefer_xla_fallback).
-_STEM_XLA = os.environ.get("PCNN_PALLAS_STEM_XLA", "0") not in ("", "0")
+_STEM_XLA = os.environ.get("PCNN_PALLAS_STEM_XLA", "0") not in ("", "0")  # graftcheck: disable=env-outside-config -- import-time hybrid gate read once into a module constant
 
 
 class Epilogue(NamedTuple):
@@ -347,6 +347,41 @@ def _wgrad_tap_kernel(taps, w_col, lo, tail, n_in, *refs):
         ).astype(gw_ref.dtype)
 
 
+# Observation hook for the static budget verifier (analysis/pallas_budget):
+# when set, every block-size decision reports its VMEM model here, so
+# `python -m parallel_cnn_tpu check` evaluates the same formula the
+# kernels size with — no drift between the lint model and the runtime
+# model is possible.  (tag, n, bb, per_img, w_bytes, modeled_bytes).
+_budget_observer = None
+
+
+def _vmem_per_img(
+    rows: int,
+    cins: Sequence[int],
+    tap_cins: Sequence[int],
+    couts: Sequence[int],
+    esz: int,
+    out_esz: int,
+    pair_temps: int = 0,
+) -> int:
+    """Modeled VMEM bytes one image contributes to a pipeline block:
+    double-buffered in/out blocks, Mosaic's materialized per-tap slice
+    copies (input dtype), f32 accumulator + per-tap dot result, and the
+    N-pair packing temporaries (see _pick_bb's docstring for the r5
+    accounting notes)."""
+    cout = sum(couts)
+    return rows * (
+        esz * (2 * sum(cins) + sum(tap_cins))
+        + out_esz * 2 * cout
+        + 4 * 2 * cout
+        # N-pair packing (r5): each paired dot materializes a full-rows
+        # (nb, 2·cout) f32 `big`; count every pair as simultaneously
+        # live (conservative — Mosaic's scoped-stack accounting proved
+        # 1.7MB tighter than the pre-pairing model at the stem shape).
+        + 4 * 2 * max(couts, default=0) * pair_temps
+    )
+
+
 def _pick_bb(
     n: int,
     rows: int,
@@ -378,16 +413,8 @@ def _pick_bb(
     dtypes, so the strictest (smallest-itemsize) tile governs. Pick the
     largest legal divisor under the VMEM target, else the smallest legal
     one above it (bb == n is always legal)."""
-    cout = sum(couts)
-    per_img = rows * (
-        esz * (2 * sum(cins) + sum(tap_cins))
-        + out_esz * 2 * cout
-        + 4 * 2 * cout
-        # N-pair packing (r5): each paired dot materializes a full-rows
-        # (nb, 2·cout) f32 `big`; count every pair as simultaneously
-        # live (conservative — Mosaic's scoped-stack accounting proved
-        # 1.7MB tighter than the pre-pairing model at the stem shape).
-        + 4 * 2 * max(couts, default=0) * pair_temps
+    per_img = _vmem_per_img(
+        rows, cins, tap_cins, couts, esz, out_esz, pair_temps
     )
     avail = _VMEM_BUDGET - 2 * w_bytes
     want = max(1, avail // max(per_img, 1))
@@ -398,13 +425,19 @@ def _pick_bb(
     ]
     below = [d for d in legal if d <= want]
     if below:
-        return max(below)
+        bb = max(below)
+        if _budget_observer is not None:
+            _budget_observer(tag, n, bb, per_img, w_bytes,
+                             bb * per_img + 2 * w_bytes)
+        return bb
     # No legal divisor fits the budget — the tiling constraint forces a
     # bigger block. Surface how far over the model says we land: over
     # budget is fine (the limit leaves headroom) but worth a debug trace;
     # over the hard limit predicts a Mosaic scoped-VMEM OOM.
     bb = min(legal)
     modeled = bb * per_img + 2 * w_bytes
+    if _budget_observer is not None:
+        _budget_observer(tag, n, bb, per_img, w_bytes, modeled)
     if modeled > _VMEM_LIMIT:
         log.warning(
             "pallas %s block bb=%d models %.1fMB VMEM, over the %.0fMB "

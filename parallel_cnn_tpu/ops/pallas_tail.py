@@ -105,7 +105,7 @@ def split_tail(model) -> Optional[TailSplit]:
 
 
 def _use_kernel() -> bool:
-    env = os.environ.get("PCNN_TAIL_KERNEL")
+    env = os.environ.get("PCNN_TAIL_KERNEL")  # graftcheck: disable=env-outside-config -- call-time toggle so tests and the budget analyzer can force the kernel leg per-trace
     if env is not None:
         return env != "0"
     return not _interpret()
@@ -207,6 +207,18 @@ def _kernel_forward(x, w, b, oh, pool):
         per_img = C * x.dtype.itemsize
         ins = [xf]
     bb = _batch_block(B, max(1, min(128, _TAIL_BLOCK_BYTES // max(per_img, 1))))
+    if pallas_conv._budget_observer is not None:
+        # Same shape of report as _pick_bb: double-buffered input blocks,
+        # whole-weight residency, double-buffered oh/loss/dl blocks.
+        w_bytes = w.size * w.dtype.itemsize + K * 4
+        modeled = (
+            2 * bb * per_img + 2 * w_bytes
+            + 2 * bb * K * oh.dtype.itemsize          # one-hot block
+            + 2 * bb * (K + 1) * 4                    # dl + loss outputs
+        )
+        pallas_conv._budget_observer(
+            f"tail/{pool}", B, bb, per_img, w_bytes, modeled
+        )
     if pool == "none":
         in_specs = [pl.BlockSpec((bb, C), lambda i: (i, 0),
                                  memory_space=pltpu.VMEM)]

@@ -156,7 +156,7 @@ def distributed_init(coordinator: Optional[str] = None, num_processes: Optional[
 
     if retry is None:
         retry = RetryPolicy(
-            attempts=int(os.environ.get("PCNN_INIT_RETRIES", "3")),
+            attempts=int(os.environ.get("PCNN_INIT_RETRIES", "3")),  # graftcheck: disable=env-outside-config -- bootstrap retry knob read at call time, shared contract with parallel.distributed
             base_delay=0.5,
         )
     retry_call(

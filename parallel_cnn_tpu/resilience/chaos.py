@@ -145,15 +145,15 @@ def hidden_native_lib():
     """
     modname = "parallel_cnn_tpu.data.native"
     saved_module = sys.modules.pop(modname, None)
-    saved_env = os.environ.get("PCNN_DISABLE_NATIVE")
-    os.environ["PCNN_DISABLE_NATIVE"] = "1"
+    saved_env = os.environ.get("PCNN_DISABLE_NATIVE")  # graftcheck: disable=env-outside-config -- chaos-harness save/force/restore around the hidden-native window
+    os.environ["PCNN_DISABLE_NATIVE"] = "1"  # graftcheck: disable=env-outside-config -- chaos-harness save/force/restore around the hidden-native window
     try:
         yield
     finally:
         if saved_env is None:
-            os.environ.pop("PCNN_DISABLE_NATIVE", None)
+            os.environ.pop("PCNN_DISABLE_NATIVE", None)  # graftcheck: disable=env-outside-config -- chaos-harness save/force/restore around the hidden-native window
         else:
-            os.environ["PCNN_DISABLE_NATIVE"] = saved_env
+            os.environ["PCNN_DISABLE_NATIVE"] = saved_env  # graftcheck: disable=env-outside-config -- chaos-harness save/force/restore around the hidden-native window
         sys.modules.pop(modname, None)
         if saved_module is not None:
             sys.modules[modname] = saved_module

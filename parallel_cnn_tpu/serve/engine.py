@@ -163,7 +163,8 @@ class Engine:
         )
         t0 = time.perf_counter()
         compiled = jax.jit(predict).lower(sds).compile()
-        self.stats.compile_seconds[bucket] = time.perf_counter() - t0
+        with self._lock:
+            self.stats.compile_seconds[bucket] = time.perf_counter() - t0
         return compiled
 
     def _executable(self, bucket: int):
@@ -222,7 +223,8 @@ class Engine:
             x = np.concatenate([x, pad], axis=0)
         ex = self._executable(bucket)
         y = ex(jax.device_put(x, self.device))
-        self.stats.predicts += 1
+        with self._lock:
+            self.stats.predicts += 1
         return np.asarray(y)[:n]
 
 

@@ -52,7 +52,7 @@ def probe_env() -> dict:
     with them the sitecustomize hook that registers the axon TPU
     plugin, so probes reported healthy CPU boxes as the platform truth.
     """
-    env = dict(os.environ)
+    env = dict(os.environ)  # graftcheck: disable=env-outside-config -- subprocess must inherit the FULL parent environment (see docstring: allowlists dropped the plugin hook)
     root = os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )

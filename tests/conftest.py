@@ -86,3 +86,9 @@ def pytest_configure(config):
         "ops/pallas_tail.py, update-on-arrival zoo step, bf16 loss "
         "scaling — CPU interpret-mode safe)",
     )
+    config.addinivalue_line(
+        "markers",
+        "analysis: graftcheck static-analysis tests (analysis/ — jaxpr "
+        "invariants, AST lint, Pallas VMEM budgets, concurrency lint + "
+        "race harness)",
+    )
