@@ -9,6 +9,11 @@
 #   headline  bench.py headline line only (~10-20 min) — the cheap repeat
 #             for every subsequent heal; lines append, and the driver
 #             headline is a median over same-session samples.
+#   comm-multihost
+#             2-process hierarchical-collective smoke
+#             (benches/comm_multihost.py): weak-scaling rows + the
+#             hier-vs-psum parity gate. CPU-only and self-contained —
+#             runnable without the relay, so it can gate commits too.
 #
 # All artifacts append/write under docs/ with the given tag (default: the
 # UTC date), so repeated runs accumulate evidence instead of overwriting.
@@ -23,6 +28,18 @@ cd "$(dirname "$0")/.."
 export PYTHONPATH="${PYTHONPATH:+$PYTHONPATH:}$PWD"
 LOG="docs/playbook_${TAG}.log"
 echo "=== playbook ${MODE} start $(date -u +%FT%TZ) ===" >> "$LOG"
+
+if [ "$MODE" = "comm-multihost" ]; then
+  echo "--- comm-multihost smoke ---" >> "$LOG"
+  OUT="docs/comm_multihost_${TAG}.txt"
+  timeout 900 python benches/comm_multihost.py > "$OUT" 2>&1
+  RC=$?; echo "comm-multihost rc=$RC" >> "$LOG"
+  # The gate line is the contract: both legs' hier-vs-psum parity <= 1e-5.
+  grep -q 'COMM_MULTIHOST_GATE PASS' "$OUT" || RC=1
+  [ $RC -ne 0 ] && OVERALL=1
+  echo "=== playbook ${MODE} end rc=${OVERALL} $(date -u +%FT%TZ) ===" >> "$LOG"
+  exit $OVERALL
+fi
 
 if [ "$MODE" = "full" ]; then
   echo "--- step 0: sanity ---" >> "$LOG"

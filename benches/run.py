@@ -416,9 +416,24 @@ def bench_comm(quick: bool) -> List[Row]:
     isolate the collective schedule; the baseline_src column carries each
     variant's final-step loss delta vs psum, so the table double-checks
     the ≤1e-5 (ring) / ≤1e-2 (bf16) parity contract while it measures.
+
+    Two further legs on the same model/batch:
+
+    - Hierarchical: the device set re-folded into an emulated 2-host
+      (host, device) mesh; `hier` / `hier_bf16` run the two-level rings
+      (intra-host RS → host-axis shard exchange → all-gathers) against a
+      `psum_hier` reference ON THE SAME MESH — BatchNorm batch stats are
+      shard-local, so parity is only meaningful within one mesh shape.
+    - ZeRO: the fused update-on-arrival step with replicated state
+      (ZeRO-2, `zero2_ring`) vs resident 1/n shards + just-in-time f32
+      param gathers at the step head (ZeRO-3, `zero3_ring`); the zero3
+      row's baseline_src carries its throughput ratio vs zero2 — the
+      memory-for-bandwidth trade's cost, which docs/collectives.md
+      budgets at ≥0.9x.
+
     On the 8-virtual-device CPU harness the "ICI" is shared-memory copies
     — ranking is indicative, the TPU run is the real evidence."""
-    from parallel_cnn_tpu.config import CommConfig, MeshConfig
+    from parallel_cnn_tpu.config import CommConfig, FusedStepConfig, MeshConfig
     from parallel_cnn_tpu.data import synthetic
     from parallel_cnn_tpu.nn import cifar
     from parallel_cnn_tpu.train import zoo
@@ -471,6 +486,113 @@ def bench_comm(quick: bool) -> List[Row]:
                 baseline=None,
                 baseline_src=(f"{n_dev}dev b{batch} accum2; "
                               f"loss-psum={dloss:+.2e}"),
+                value_range=ips_range, value_samples=n_s).finish()
+        )
+
+    # --- Hierarchical leg: same devices re-folded as 2 emulated hosts ---
+    if n_dev >= 4 and n_dev % 2 == 0:
+        hmesh = mesh_lib.make_hier_mesh(n_hosts=2)
+        hx, hy = mesh_lib.shard_batch(
+            hmesh, (jnp.asarray(imgs), jnp.asarray(labels))
+        )
+        hier_variants = [
+            ("psum_hier", CommConfig(impl="psum")),
+            ("hier", CommConfig(impl="hierarchical", hosts=2)),
+            ("hier_bf16",
+             CommConfig(impl="hierarchical", wire_dtype="bfloat16", hosts=2)),
+        ]
+        for name, comm in hier_variants:
+            st = zoo.init_state(model, jax.random.key(0), cifar.IN_SHAPE, opt)
+            step = zoo.make_train_step(
+                model, opt, accum_steps=2, mesh=hmesh, comm=comm
+            )
+            pst, ploss = st, None
+            for _ in range(3):
+                pst, ploss = step(pst, hx, hy)
+            losses[name] = float(ploss)
+
+            def thunk(carry, step=step, hx=hx, hy=hy):
+                s = carry[0] if carry is not None else zoo.init_state(
+                    model, jax.random.key(0), cifar.IN_SHAPE, opt
+                )
+                return step(s, hx, hy)
+
+            ips, ips_range, n_s = _sampled_ips(
+                thunk, repeats=10 if quick else 30, images_per_call=batch
+            )
+            dloss = losses[name] - losses["psum_hier"]
+            rows.append(
+                Row(f"comm_{name}_accum_mesh_train", ips, "images/sec",
+                    baseline=None,
+                    baseline_src=(f"2host x{n_dev // 2}dev b{batch} accum2; "
+                                  f"loss-psum_hier={dloss:+.2e}"),
+                    value_range=ips_range, value_samples=n_s).finish()
+            )
+
+    # --- ZeRO leg: replicated fused step (ZeRO-2) vs resident shards with
+    # just-in-time f32 param gathers (ZeRO-3), same ring comm/batch/lr ---
+    zcomm = CommConfig(impl="ring")
+    zero_ips = {}
+    zero_losses = {}
+    for name, zero in (("zero2_ring", 2), ("zero3_ring", 3)):
+        if zero == 2:
+            fused = FusedStepConfig(update=True, tail=True)
+            st0, n_buckets = zoo.init_fused_state(
+                model, jax.random.key(0), cifar.IN_SHAPE, n_data=n_dev,
+                fused=fused, bucket_bytes=zcomm.bucket_bytes,
+            )
+            step = zoo.make_fused_train_step(
+                model, lr=0.05, momentum=0.9, accum_steps=2, mesh=mesh,
+                augment=None, comm=zcomm, fused=fused, n_buckets=n_buckets,
+            )
+
+            def init_st():
+                return zoo.init_fused_state(
+                    model, jax.random.key(0), cifar.IN_SHAPE, n_data=n_dev,
+                    fused=FusedStepConfig(update=True, tail=True),
+                    bucket_bytes=zcomm.bucket_bytes,
+                )[0]
+
+        else:
+            fused = FusedStepConfig(update=True, tail=True, zero=3)
+            st0, plan = zoo.init_zero3_state(
+                model, jax.random.key(0), cifar.IN_SHAPE, n_data=n_dev,
+                fused=fused, bucket_bytes=zcomm.bucket_bytes,
+            )
+            step = zoo.make_zero3_train_step(
+                model, lr=0.05, momentum=0.9, accum_steps=2, mesh=mesh,
+                augment=None, comm=zcomm, fused=fused, plan=plan,
+            )
+
+            def init_st(fused=fused):
+                return zoo.init_zero3_state(
+                    model, jax.random.key(0), cifar.IN_SHAPE, n_data=n_dev,
+                    fused=fused, bucket_bytes=zcomm.bucket_bytes,
+                )[0]
+
+        pst, ploss = st0, None
+        for _ in range(3):
+            pst, ploss = step(pst, x, y)
+        zero_losses[name] = float(ploss)
+
+        def thunk(carry, step=step, init_st=init_st):
+            s = carry[0] if carry is not None else init_st()
+            return step(s, x, y)
+
+        ips, ips_range, n_s = _sampled_ips(
+            thunk, repeats=10 if quick else 30, images_per_call=batch
+        )
+        zero_ips[name] = ips
+        if zero == 2:
+            src = f"{n_dev}dev b{batch} accum2 fused"
+        else:
+            dloss = zero_losses[name] - zero_losses["zero2_ring"]
+            ratio = ips / zero_ips["zero2_ring"]
+            src = (f"{n_dev}dev b{batch} accum2 fused; "
+                   f"loss-zero2={dloss:+.2e}; ips/zero2={ratio:.3f}x")
+        rows.append(
+            Row(f"comm_{name}_accum_mesh_train", ips, "images/sec",
+                baseline=None, baseline_src=src,
                 value_range=ips_range, value_samples=n_s).finish()
         )
     return rows

@@ -10,16 +10,24 @@ sub-jaxprs) checking:
   ``model`` axes at top level).  A typo'd axis name surfaces at run
   time as an unbound-axis error on device — here it's a lint failure.
 - ``ring-permutation``: every ``ppermute`` permutation must be a single
-  cycle covering all participants.  A broken ring (two sub-cycles, a
-  dropped rank) reduces only part of the gradient and silently
-  desynchronizes replicas — the exact class of bug arXiv:1810.11112's
-  scheduling constraints exist to prevent.
-- ``f32-wire`` (masters never ride bf16): any ``ppermute`` whose output
-  reaches a jaxpr output through *layout-only* ops (reshape, slice,
-  concatenate, dtype cast, …) is a param all-gather wire and must carry
-  float32.  Gradient wires may be bf16 — they pass through optimizer
-  arithmetic before reaching an output, which breaks the transparent
-  chain, so they are exempt by construction.
+  cycle covering all participants — and, when the enclosing shard_map
+  mesh gives the axis a size, covering *every rank of its axis*
+  (``set(range(size))``).  A broken ring (two sub-cycles, a dropped
+  rank) reduces only part of the gradient and silently desynchronizes
+  replicas — the exact class of bug arXiv:1810.11112's scheduling
+  constraints exist to prevent.  Hierarchical topologies ring each
+  mesh axis separately, so the requirement is per-axis: a dev-axis
+  ring never names host ranks and vice versa.
+- ``f32-wire`` (masters never ride bf16): two directions.
+  Output side: any ``ppermute`` whose output reaches a jaxpr output
+  through *layout-only* ops (reshape, slice, concatenate, dtype cast,
+  …) is a param all-gather wire and must carry float32.  Input side
+  (the ZeRO-3 just-in-time gathers): any ``ppermute`` fed from a jaxpr
+  *input* through layout-only ops is gathering resident state — master
+  weights or optimizer shards — and must equally carry float32.
+  Gradient wires may be bf16 — they are produced by backward-pass
+  arithmetic and consumed by optimizer arithmetic, which breaks the
+  transparent chain on both sides, so they are exempt by construction.
 - ``donated-reuse``: an operand donated to a pjit call may not be read
   by any later equation — donation aliases the buffer to the output.
 - ``weak-type``: weak-typed entry arguments and 0-d weak constants
@@ -30,12 +38,15 @@ sub-jaxprs) checking:
 
 from __future__ import annotations
 
-from typing import Callable, Iterable, List, Optional, Sequence, Set, Tuple
+from typing import (
+    Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple,
+)
 
 from parallel_cnn_tpu.analysis.diagnostics import Diagnostic, Severity
 
-# Declared mesh axes (parallel/mesh.py DATA_AXIS/MODEL_AXIS).
-DECLARED_AXES = {"data", "model"}
+# Declared mesh axes (parallel/mesh.py DATA_AXIS/MODEL_AXIS/HOST_AXIS).
+# Sizes are unknown (None) until a shard_map mesh refines them.
+DECLARED_AXES = {"data", "model", "host"}
 
 # Primitives that only rearrange/retag values: a ppermute output flowing
 # through ONLY these to a jaxpr output means the wire dtype is what the
@@ -78,9 +89,12 @@ def _sub_jaxprs(eqn) -> Iterable:
                 yield item           # raw Jaxpr
 
 
-def walk_jaxpr(jaxpr, visit: Callable, allowed: Set[str]) -> None:
+def walk_jaxpr(jaxpr, visit: Callable, allowed: Dict[str, Optional[int]]) -> None:
     """Depth-first walk calling ``visit(jaxpr, eqn, allowed)``; the
-    allowed-axis set is refined at each shard_map from its mesh."""
+    allowed-axis mapping (axis name -> size, None while unknown) is
+    refined at each shard_map from its mesh — inside the body both the
+    axis NAMES and their SIZES are known, which is what lets the ring
+    check demand full-axis coverage per axis."""
     for eqn in jaxpr.eqns:
         visit(jaxpr, eqn, allowed)
         sub_allowed = allowed
@@ -88,21 +102,24 @@ def walk_jaxpr(jaxpr, visit: Callable, allowed: Set[str]) -> None:
             mesh = eqn.params.get("mesh")
             axis_names = getattr(mesh, "axis_names", None)
             if axis_names:
-                sub_allowed = set(axis_names)
+                shape = getattr(mesh, "shape", None)
+                sizes = dict(shape) if shape is not None else {}
+                sub_allowed = {a: sizes.get(a) for a in axis_names}
         for sub in _sub_jaxprs(eqn):
             walk_jaxpr(sub, visit, sub_allowed)
 
 
-def _is_single_cycle(perm: Sequence[Tuple[int, int]]) -> bool:
+def _cycle_members(perm: Sequence[Tuple[int, int]]) -> Optional[Set[int]]:
+    """The member set of ``perm`` when it is one single cycle, else None."""
     if not perm:
-        return False
+        return None
     srcs = [s for s, _ in perm]
     dsts = [d for _, d in perm]
     members = set(srcs) | set(dsts)
     if len(set(srcs)) != len(srcs) or len(set(dsts)) != len(dsts):
-        return False
+        return None
     if set(srcs) != members or set(dsts) != members:
-        return False
+        return None
     nxt = dict(perm)
     start = srcs[0]
     seen = set()
@@ -110,7 +127,13 @@ def _is_single_cycle(perm: Sequence[Tuple[int, int]]) -> bool:
     while cur not in seen:
         seen.add(cur)
         cur = nxt[cur]
-    return cur == start and seen == members
+    if cur == start and seen == members:
+        return members
+    return None
+
+
+def _is_single_cycle(perm: Sequence[Tuple[int, int]]) -> bool:
+    return _cycle_members(perm) is not None
 
 
 def _var_key(v) -> Optional[int]:
@@ -118,13 +141,18 @@ def _var_key(v) -> Optional[int]:
     return id(v) if not hasattr(v, "val") else None
 
 
-def _wire_reachable_permutes(jaxpr):
-    """ppermute eqns whose outputs reach jaxpr outvars through
-    transparent ops only."""
+def _producer_map(jaxpr):
     producer = {}
     for eqn in jaxpr.eqns:
         for ov in eqn.outvars:
             producer[_var_key(ov)] = eqn
+    return producer
+
+
+def _wire_reachable_permutes(jaxpr):
+    """ppermute eqns whose outputs reach jaxpr outvars through
+    transparent ops only."""
+    producer = _producer_map(jaxpr)
     hits = []
     seen_eqns: Set[int] = set()
     frontier = [v for v in jaxpr.outvars]
@@ -149,6 +177,41 @@ def _wire_reachable_permutes(jaxpr):
     return hits
 
 
+def _resident_fed_permutes(jaxpr):
+    """ppermute eqns fed from jaxpr invars/constvars through transparent
+    ops only — the wire is moving resident state (ZeRO-3 master-weight /
+    optimizer shards gathered just-in-time at the step head), not values
+    computed this step.  Gradient wires are produced by backward-pass
+    arithmetic, which breaks the chain, so they never match."""
+    producer = _producer_map(jaxpr)
+    resident = {
+        _var_key(v)
+        for v in (*jaxpr.invars, *jaxpr.constvars)
+        if _var_key(v) is not None
+    }
+    memo: Dict[int, bool] = {}
+
+    def from_resident(var) -> bool:
+        k = _var_key(var)
+        if k is None:
+            return False
+        if k in resident:
+            return True
+        if k in memo:
+            return memo[k]
+        memo[k] = False  # cycle guard (jaxprs are SSA; belt-and-braces)
+        eqn = producer.get(k)
+        if eqn is not None and eqn.primitive.name in _TRANSPARENT:
+            memo[k] = any(from_resident(iv) for iv in eqn.invars)
+        return memo[k]
+
+    return [
+        eqn for eqn in jaxpr.eqns
+        if eqn.primitive.name == "ppermute"
+        and any(from_resident(iv) for iv in eqn.invars)
+    ]
+
+
 # ---------------------------------------------------------------------------
 # Rules over one traced entry point
 # ---------------------------------------------------------------------------
@@ -159,7 +222,7 @@ def analyze_closed_jaxpr(name: str, closed) -> List[Diagnostic]:
     diags: List[Diagnostic] = []
     file = f"<jaxpr:{name}>"
 
-    def visit(jaxpr, eqn, allowed: Set[str]) -> None:
+    def visit(jaxpr, eqn, allowed: Dict[str, Optional[int]]) -> None:
         prim = eqn.primitive.name
         for axis in _axis_names(eqn):
             if axis not in allowed:
@@ -173,7 +236,8 @@ def analyze_closed_jaxpr(name: str, closed) -> List[Diagnostic]:
                 ))
         if prim == "ppermute":
             perm = list(eqn.params.get("perm", ()))
-            if not _is_single_cycle(perm):
+            members = _cycle_members(perm)
+            if members is None:
                 diags.append(Diagnostic(
                     rule="ring-permutation",
                     severity=Severity.ERROR,
@@ -183,13 +247,33 @@ def analyze_closed_jaxpr(name: str, closed) -> List[Diagnostic]:
                             "cycle over all participants; a broken ring "
                             "reduces only part of the gradient",
                 ))
+            else:
+                # Per-axis coverage: on hierarchical meshes each ring
+                # permutes WITHIN its own axis, so the cycle must hit
+                # every rank of that axis — a ring over a subset leaves
+                # the dropped ranks permanently out of the reduction.
+                for axis in _axis_names(eqn):
+                    size = allowed.get(axis)
+                    if size is not None and members != set(range(size)):
+                        diags.append(Diagnostic(
+                            rule="ring-permutation",
+                            severity=Severity.ERROR,
+                            file=file,
+                            line=0,
+                            message=f"ppermute over axis '{axis}' (size "
+                                    f"{size}) cycles ranks {sorted(members)} "
+                                    "only; the ring must cover every rank of "
+                                    "its axis — dropped ranks neither "
+                                    "contribute nor receive the reduction",
+                        ))
         if "donated_invars" in eqn.params:
             diags.extend(_donated_reuse(file, jaxpr, eqn))
 
-    walk_jaxpr(closed.jaxpr, visit, set(DECLARED_AXES))
+    walk_jaxpr(closed.jaxpr, visit, {a: None for a in DECLARED_AXES})
 
-    # f32-wire: applied per sub-jaxpr so the "reaches an output through
-    # transparent ops" slice respects scope boundaries.
+    # f32-wire: applied per sub-jaxpr so both slices — "reaches an output
+    # through transparent ops" and "fed from an input through transparent
+    # ops" — respect scope boundaries.
     def wire_visit(jaxpr) -> None:
         for eqn in _wire_reachable_permutes(jaxpr):
             for ov in eqn.outvars:
@@ -204,6 +288,22 @@ def analyze_closed_jaxpr(name: str, closed) -> List[Diagnostic]:
                                 "output through layout-only ops: a param "
                                 "all-gather is riding a non-f32 wire — "
                                 "masters never ride bf16",
+                    ))
+        for eqn in _resident_fed_permutes(jaxpr):
+            for ov in eqn.outvars:
+                dtype = getattr(ov.aval, "dtype", None)
+                if dtype is not None and str(dtype) not in ("float32", "float64"):
+                    diags.append(Diagnostic(
+                        rule="f32-wire",
+                        severity=Severity.ERROR,
+                        file=file,
+                        line=0,
+                        message=f"ppermute wire ({dtype}) is fed from a "
+                                "jaxpr input through layout-only ops: a "
+                                "just-in-time gather of resident state "
+                                "(master weights / optimizer shards) is "
+                                "riding a non-f32 wire — masters never "
+                                "ride bf16",
                     ))
 
     def _walk_all(jaxpr) -> None:
@@ -380,6 +480,64 @@ def trace_entry_points(fast: bool = False) -> List[Tuple[str, object]]:
             "zoo.fused_step.ring_bf16",
             jax.make_jaxpr(fused_step)(fst, zx, zy),
         ))
+
+        # ZeRO-3 on the flat ring, sharpest setting again: bf16 gradient
+        # wire, bf16 activations — the HEAD just-in-time param gathers
+        # must still carry f32 masters (the input-side f32-wire slice).
+        z3 = FusedStepConfig(
+            update=True, tail=True, act_dtype="bfloat16", zero=3
+        )
+        zst, zplan = zoo.init_zero3_state(
+            model, jax.random.key(1), cifar.IN_SHAPE,
+            n_data=n_data, fused=z3, bucket_bytes=ring_bf16.bucket_bytes,
+        )
+        zero3_step = zoo.make_zero3_train_step(
+            model, lr=0.01, momentum=0.9, accum_steps=2, mesh=mesh,
+            augment=None, comm=ring_bf16, fused=z3, plan=zplan,
+        )
+        out.append((
+            "zoo.zero3_step.ring_bf16",
+            jax.make_jaxpr(zero3_step)(zst, zx, zy),
+        ))
+
+    # Hierarchical two-level rings need a (host, device) mesh; 2 emulated
+    # hosts over the local devices exercises every per-axis ppermute the
+    # multi-host path emits (ring coverage is checked per axis).
+    if n_dev >= 4 and n_dev % 2 == 0:
+        hmesh = mesh_lib.make_hier_mesh(n_hosts=2, devices=jax.devices()[:n_dev])
+        n_host, n_hdev = mesh_lib.hier_axis_sizes(hmesh)
+        hx = jnp.zeros((2 * n_dev, *cifar.IN_SHAPE), jnp.float32)
+        hy = jnp.zeros((2 * n_dev,), jnp.int32)
+        with hmesh:
+            hier_bf16 = CommConfig(
+                impl="hierarchical", wire_dtype="bfloat16", hosts=2
+            )
+            opt = zoo.make_optimizer(0.01, momentum=0.9)
+            hier_step = zoo.make_train_step(
+                model, opt, accum_steps=2, mesh=hmesh, comm=hier_bf16
+            )
+            hst = zoo.init_state(model, jax.random.key(1), cifar.IN_SHAPE, opt)
+            out.append((
+                "zoo.comm_step.hier_bf16",
+                jax.make_jaxpr(hier_step)(hst, hx, hy),
+            ))
+
+            z3h = FusedStepConfig(
+                update=True, tail=True, act_dtype="bfloat16", zero=3
+            )
+            zsth, zplanh = zoo.init_zero3_state(
+                model, jax.random.key(1), cifar.IN_SHAPE,
+                n_data=n_hdev, fused=z3h,
+                bucket_bytes=hier_bf16.bucket_bytes, n_host=n_host,
+            )
+            zero3_hier = zoo.make_zero3_train_step(
+                model, lr=0.01, momentum=0.9, accum_steps=2, mesh=hmesh,
+                augment=None, comm=hier_bf16, fused=z3h, plan=zplanh,
+            )
+            out.append((
+                "zoo.zero3_step.hier_bf16",
+                jax.make_jaxpr(zero3_hier)(zsth, hx, hy),
+            ))
     return out
 
 

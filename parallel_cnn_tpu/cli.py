@@ -106,11 +106,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "(parallel/zoo_sharding.py) composed with "
                         "--mesh-data DP on the 2-D mesh")
     p.add_argument("--comm-impl", default=None,
-                   choices=["psum", "ring"],
+                   choices=["psum", "ring", "hierarchical"],
                    help="mesh runs: gradient-collective algorithm "
-                        "(parallel/collectives.py) — monolithic psum, or "
+                        "(parallel/collectives.py) — monolithic psum, "
                         "bucketed ring reduce-scatter/all-gather over the "
-                        "data axis. Default: PCNN_COMM_IMPL, else the "
+                        "data axis, or the two-level hierarchical ring "
+                        "over a (host, device) mesh (inter-host links "
+                        "carry 1/n_dev of the payload; docs/collectives.md)"
+                        ". Default: PCNN_COMM_IMPL, else the "
                         "historical implicit psum/GSPMD path")
     p.add_argument("--comm-bucket-mb", type=float, default=None, metavar="MB",
                    help="ring collective bucket size in MiB "
@@ -120,6 +123,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="collective payload dtype on the wire; bfloat16 "
                         "halves ICI bytes, accumulation stays f32 "
                         "(PCNN_COMM_WIRE_DTYPE)")
+    p.add_argument("--comm-hosts", type=int, default=None, metavar="N",
+                   help="--comm-impl hierarchical: host-axis size of the "
+                        "(host, device) mesh. Default (PCNN_COMM_HOSTS "
+                        "unset): derive one host row per jax.distributed "
+                        "process; an explicit N splits one process's "
+                        "devices into N emulated hosts (CPU testing)")
     p.add_argument("--fused-step", action="store_true",
                    help="fused training step (PCNN_FUSED_STEP): fused "
                         "pool→FC→softmax-CE loss tail, bf16 activations "
@@ -220,7 +229,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
     # all-defaults → comm=None, the historical implicit-collective path.
     comm = CommConfig.from_env()
     if (args.comm_impl is not None or args.comm_bucket_mb is not None
-            or args.comm_wire_dtype is not None):
+            or args.comm_wire_dtype is not None
+            or args.comm_hosts is not None):
         base = comm or CommConfig()
         comm = dataclasses.replace(
             base,
@@ -229,6 +239,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
                           if args.comm_bucket_mb is not None
                           else base.bucket_bytes),
             wire_dtype=args.comm_wire_dtype or base.wire_dtype,
+            hosts=(args.comm_hosts if args.comm_hosts is not None
+                   else base.hosts),
         )
     # Same env-then-flags layering for the fused step. --act-dtype only
     # REFINES an enabled fused path (acceptance: nothing but
@@ -636,7 +648,19 @@ def _run_zoo(args: argparse.Namespace, cfg: Config) -> int:
     # (parallel/zoo_sharding.py) — hybrid 2-D zoo training.
     mesh = None
     model_axis = (args.mesh_model or 1) > 1
-    if args.mesh_data is not None or model_axis:
+    hier = cfg.comm is not None and cfg.comm.impl == "hierarchical"
+    if hier:
+        # The hierarchical path brings its own 2-level (host, device) mesh
+        # over ALL devices — the flat mesh flags don't describe it.
+        if args.mesh_data is not None or model_axis:
+            raise SystemExit(
+                "--comm-impl hierarchical builds its own (host, device) "
+                "mesh over all devices; drop --mesh-data/--mesh-model "
+                "(size the host axis with --comm-hosts)"
+            )
+        mesh = mesh_lib.make_hier_mesh(n_hosts=cfg.comm.hosts)
+        print(f"mesh: {dict(mesh.shape)} (hierarchical)")
+    elif args.mesh_data is not None or model_axis:
         mesh = mesh_lib.make_mesh(
             MeshConfig(data=args.mesh_data, model=args.mesh_model or 1)
         )

@@ -158,10 +158,18 @@ class CommConfig:
     where the reduce algorithm, bucket granularity, and wire precision
     become knobs (docs/collectives.md has the cost model)."""
 
-    # "psum"  — monolithic lax.psum, XLA picks the algorithm (baseline);
-    # "ring"  — bucketed ring reduce-scatter + all-gather (lax.ppermute),
-    #           2(n−1)/n wire payload and an explicit schedule XLA can
-    #           overlap with microbatch compute.
+    # "psum"         — monolithic lax.psum, XLA picks the algorithm
+    #                  (baseline);
+    # "ring"         — bucketed ring reduce-scatter + all-gather
+    #                  (lax.ppermute), 2(n−1)/n wire payload and an
+    #                  explicit schedule XLA can overlap with microbatch
+    #                  compute;
+    # "hierarchical" — two-level ring over a (host, device) mesh
+    #                  (parallel/mesh.py make_hier_mesh): intra-host ring
+    #                  reduce-scatter → inter-host shard exchange over the
+    #                  host axis → intra-host all-gather (arXiv:1810.11112)
+    #                  — the multi-host topology-aware path, where the slow
+    #                  inter-host links carry only 1/n_dev of the payload.
     impl: str = "psum"
     # Bucket payload budget for impl="ring" (bytes). Small buckets pay the
     # per-hop latency many times; huge buckets lose overlap granularity.
@@ -174,10 +182,18 @@ class CommConfig:
     # the next microbatch's compute), one all-gather at the end. False
     # reduces once after the full accumulation loop.
     overlap: bool = True
+    # impl="hierarchical": host-axis size of the (host, device) mesh.
+    # None = derive from jax.distributed process topology (one host row
+    # per process); an explicit value splits a single process's devices
+    # into that many emulated hosts — the 2-process-per-host CPU
+    # emulation path the tests and benches exercise pre-TPU-relay.
+    hosts: Optional[int] = None
 
     def __post_init__(self):
-        if self.impl not in ("psum", "ring"):
+        if self.impl not in ("psum", "ring", "hierarchical"):
             raise ValueError(f"unknown comm impl {self.impl!r}")
+        if self.hosts is not None and self.hosts < 1:
+            raise ValueError(f"hosts must be >= 1, got {self.hosts}")
         if self.bucket_bytes <= 0:
             raise ValueError(
                 f"bucket_bytes must be > 0, got {self.bucket_bytes}"
@@ -191,19 +207,23 @@ class CommConfig:
     @staticmethod
     def from_env() -> Optional["CommConfig"]:
         """CommConfig from PCNN_COMM_IMPL / PCNN_COMM_BUCKET_BYTES /
-        PCNN_COMM_WIRE_DTYPE / PCNN_COMM_OVERLAP, or None when none of
-        them is set (→ the historical implicit-psum path)."""
+        PCNN_COMM_WIRE_DTYPE / PCNN_COMM_OVERLAP / PCNN_COMM_HOSTS, or
+        None when none of them is set (→ the historical implicit-psum
+        path)."""
         impl = os.environ.get("PCNN_COMM_IMPL")
         bucket = os.environ.get("PCNN_COMM_BUCKET_BYTES")
         wire = os.environ.get("PCNN_COMM_WIRE_DTYPE")
         overlap = os.environ.get("PCNN_COMM_OVERLAP")
-        if impl is None and bucket is None and wire is None and overlap is None:
+        hosts = os.environ.get("PCNN_COMM_HOSTS")
+        if (impl is None and bucket is None and wire is None
+                and overlap is None and hosts is None):
             return None
         return CommConfig(
             impl=impl or "psum",
             bucket_bytes=int(bucket) if bucket else 4 * 1024 * 1024,
             wire_dtype=wire or "float32",
             overlap=overlap != "0" if overlap is not None else True,
+            hosts=int(hosts) if hosts else None,
         )
 
 
@@ -245,8 +265,26 @@ class FusedStepConfig:
     loss_scale: float = 2.0 ** 15
     growth_interval: int = 200
     backoff: float = 0.5
+    # Optimizer-state partitioning level (requires ``update``):
+    #   2 — ZeRO-2: momentum lives as 1/n bucket shards, params stay
+    #       replicated (the round-7 behavior);
+    #   3 — ZeRO-3: params AND momentum live permanently as 1/n bucket
+    #       shards; each step all-gathers the weights just-in-time at the
+    #       head of the microbatch schedule (always f32 on the wire) and
+    #       the end-of-step update writes shards back with NO trailing
+    #       all-gather. Per-step wire volume equals ZeRO-2 — the gather
+    #       moves from the tail to the head — but resident param memory
+    #       drops to 1/n.
+    zero: int = 2
 
     def __post_init__(self):
+        if self.zero not in (2, 3):
+            raise ValueError(f"zero level must be 2 or 3, got {self.zero}")
+        if self.zero == 3 and not self.update:
+            raise ValueError(
+                "zero=3 shards params into the update-on-arrival path and "
+                "requires update=True"
+            )
         if self.act_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"unknown act dtype {self.act_dtype!r} "
@@ -277,6 +315,7 @@ class FusedStepConfig:
             return None
         return FusedStepConfig(
             act_dtype=os.environ.get("PCNN_ACT_DTYPE", "bfloat16"),
+            zero=int(os.environ.get("PCNN_ZERO_LEVEL", "2")),
         )
 
 

@@ -268,6 +268,82 @@ def ring_all_reduce(x: jax.Array, axis_name: str, axis_size: int,
 
 
 # --------------------------------------------------------------------------
+# Hierarchical (two-level) collectives over a (host, device) mesh
+# --------------------------------------------------------------------------
+#
+# The multi-host decomposition of arXiv:1810.11112: ring each mesh axis
+# separately instead of one flat ring over every device. The intra-host
+# ring moves (n_dev−1)/n_dev of the bucket over fast ICI; the inter-host
+# exchange then rings only the 1/n_dev-sized chunks over the slow links —
+# (n_host−1)/(n_host·n_dev) of the bucket per device on DCN, vs a flat
+# global ring's (N−1)/N of it. docs/collectives.md has the cost model.
+#
+# Shard indexing: after hier_reduce_scatter, device (h, d) holds the fully
+# reduced row ``d*n_host + h`` of ``x.reshape(n_host*n_dev, -1)`` — chunk d
+# from the device-axis ring, sub-chunk h from the host-axis ring.
+# hier_all_gather inverts exactly that placement, and hier_shard_rows /
+# hier_unshard_rows lay a bucket out as (n_host*n_dev, L) rows in
+# shard_map's P((host, data)) row order so ZeRO-3 resident shards line up
+# with what the rings deliver.
+
+
+def hier_reduce_scatter(x: jax.Array, host_axis: str, n_host: int,
+                        dev_axis: str, n_dev: int,
+                        wire_dtype=None) -> jax.Array:
+    """Two-level reduce-scatter: intra-host ring RS over the device axis,
+    then the inter-host shard exchange — a ring RS of the surviving chunk
+    over the host axis. Device (h, d) returns the globally summed row
+    ``d*n_host + h`` of ``x.reshape(n_host*n_dev, -1)``."""
+    local = ring_reduce_scatter(x, dev_axis, n_dev, wire_dtype)
+    return ring_reduce_scatter(local, host_axis, n_host, wire_dtype)
+
+
+def hier_all_gather(shard: jax.Array, host_axis: str, n_host: int,
+                    dev_axis: str, n_dev: int, wire_dtype=None) -> jax.Array:
+    """Exact inverse of `hier_reduce_scatter`: all-gather over the host
+    axis rebuilds each device's chunk, then the intra-host all-gather
+    rebuilds the full bucket."""
+    chunk = ring_all_gather(shard, host_axis, n_host, wire_dtype)
+    return ring_all_gather(chunk, dev_axis, n_dev, wire_dtype)
+
+
+def hier_all_reduce(x: jax.Array, host_axis: str, n_host: int,
+                    dev_axis: str, n_dev: int, wire_dtype=None) -> jax.Array:
+    """Hierarchical allreduce of a 1-D bucket (RS then AG, per level)."""
+    shard = hier_reduce_scatter(x, host_axis, n_host, dev_axis, n_dev,
+                                wire_dtype)
+    return hier_all_gather(shard, host_axis, n_host, dev_axis, n_dev,
+                           wire_dtype)
+
+
+def hier_shard_rows(bucket: jax.Array, n_host: int, n_dev: int) -> jax.Array:
+    """Lay a 1-D bucket out as (n_host*n_dev, L) resident-shard rows in
+    shard_map's P((host, data)) row order: row ``h*n_dev + d`` carries the
+    sub-chunk the hierarchical rings place on device (h, d) — i.e. row
+    ``d*n_host + h`` of the natural reshape. With n_host=1 this is just
+    ``bucket.reshape(n_dev, -1)`` (the flat-ring layout)."""
+    if bucket.shape[0] % (n_host * n_dev):
+        raise ValueError(
+            f"bucket of {bucket.shape[0]} elements does not divide over "
+            f"{n_host}x{n_dev} shards"
+        )
+    if n_host == 1:
+        return bucket.reshape(n_dev, -1)
+    return (bucket.reshape(n_dev, n_host, -1)
+            .transpose(1, 0, 2)
+            .reshape(n_host * n_dev, -1))
+
+
+def hier_unshard_rows(rows: jax.Array, n_host: int, n_dev: int) -> jax.Array:
+    """Exact inverse of `hier_shard_rows`: rows back to the 1-D bucket."""
+    if n_host == 1:
+        return rows.reshape(-1)
+    return (rows.reshape(n_host, n_dev, -1)
+            .transpose(1, 0, 2)
+            .reshape(-1))
+
+
+# --------------------------------------------------------------------------
 # Tree-level API (what the trainers call)
 # --------------------------------------------------------------------------
 
@@ -281,24 +357,44 @@ def wire_dtype_arg(comm) -> Optional[str]:
 
 
 def tree_all_reduce(tree: Any, axis_name: str, axis_size: int,
-                    comm=None) -> Any:
-    """SUM-allreduce a pytree over the named axis, per the comm config.
+                    comm=None, *, host_axis: Optional[str] = None,
+                    host_size: int = 1) -> Any:
+    """SUM-allreduce a pytree over the batch-parallel axes, per the comm
+    config.
 
     comm=None or impl="psum": one monolithic `lax.psum` (the historical
-    behavior — XLA picks the algorithm). impl="ring": the pytree is
-    bucketed (comm.bucket_bytes) and each bucket goes through the explicit
-    ring, optionally bf16-on-the-wire. Call inside shard_map; ring callers
-    must build the enclosing shard_map with the replication checker off
-    (mesh.shard_map(check_vma=False)) — ppermute outputs are per-device
-    values the checker cannot prove replicated, even though RS+AG leaves
-    every device with identical sums.
+    behavior — XLA picks the algorithm; on a hierarchical mesh it reduces
+    over both axes at once). impl="ring": the pytree is bucketed
+    (comm.bucket_bytes) and each bucket goes through the explicit ring,
+    optionally bf16-on-the-wire. impl="hierarchical": each bucket goes
+    through the two-level (host, device) ring; callers pass the host axis
+    name/size alongside the device axis. Call inside shard_map; ring and
+    hierarchical callers must build the enclosing shard_map with the
+    replication checker off (mesh.shard_map(check_vma=False)) — ppermute
+    outputs are per-device values the checker cannot prove replicated,
+    even though RS+AG leaves every device with identical sums.
     """
     if comm is None or comm.impl == "psum":
-        return lax.psum(tree, axis_name)
+        axes = (host_axis, axis_name) if host_axis is not None else axis_name
+        return lax.psum(tree, axes)
+    wire = wire_dtype_arg(comm)
+    if comm.impl == "hierarchical":
+        if host_axis is None:
+            raise ValueError(
+                "impl='hierarchical' needs a (host, device) mesh — pass "
+                "host_axis/host_size (mesh.make_hier_mesh builds the mesh)"
+            )
+        plan = plan_buckets(tree, comm.bucket_bytes,
+                            shards=host_size * axis_size)
+        buckets = [
+            hier_all_reduce(b, host_axis, host_size, axis_name, axis_size,
+                            wire)
+            for b in flatten_buckets(tree, plan)
+        ]
+        return unflatten_buckets(buckets, plan)
     if comm.impl != "ring":
         raise ValueError(f"unknown comm impl {comm.impl!r}")
     plan = plan_buckets(tree, comm.bucket_bytes, shards=axis_size)
-    wire = wire_dtype_arg(comm)
     buckets = [
         ring_all_reduce(b, axis_name, axis_size, wire)
         for b in flatten_buckets(tree, plan)
@@ -307,11 +403,21 @@ def tree_all_reduce(tree: Any, axis_name: str, axis_size: int,
 
 
 def reduce_scatter_buckets(buckets: Sequence[jax.Array], axis_name: str,
-                           axis_size: int, wire_dtype=None) -> List[jax.Array]:
-    """Ring reduce-scatter each bucket → per-device shard list. The
-    overlap building block: train/zoo.py calls this per microbatch (the
-    shards accumulate sharded, 1/n the memory of full grads) and defers
-    the single `all_gather_buckets` to after the last microbatch."""
+                           axis_size: int, wire_dtype=None, *,
+                           host_axis: Optional[str] = None,
+                           host_size: int = 1) -> List[jax.Array]:
+    """Reduce-scatter each bucket → per-device shard list. The overlap
+    building block: train/zoo.py calls this per microbatch (the shards
+    accumulate sharded, 1/n the memory of full grads) and defers the
+    single `all_gather_buckets` to after the last microbatch. With a
+    host axis the two-level hierarchical ring runs instead of the flat
+    one (buckets must be planned with shards=host_size*axis_size)."""
+    if host_axis is not None:
+        return [
+            hier_reduce_scatter(b, host_axis, host_size, axis_name,
+                                axis_size, wire_dtype)
+            for b in buckets
+        ]
     return [
         ring_reduce_scatter(b, axis_name, axis_size, wire_dtype)
         for b in buckets
@@ -319,8 +425,16 @@ def reduce_scatter_buckets(buckets: Sequence[jax.Array], axis_name: str,
 
 
 def all_gather_buckets(shards: Sequence[jax.Array], axis_name: str,
-                       axis_size: int, wire_dtype=None) -> List[jax.Array]:
+                       axis_size: int, wire_dtype=None, *,
+                       host_axis: Optional[str] = None,
+                       host_size: int = 1) -> List[jax.Array]:
     """Inverse of `reduce_scatter_buckets`: rematerialize full buckets."""
+    if host_axis is not None:
+        return [
+            hier_all_gather(s, host_axis, host_size, axis_name, axis_size,
+                            wire_dtype)
+            for s in shards
+        ]
     return [
         ring_all_gather(s, axis_name, axis_size, wire_dtype)
         for s in shards

@@ -28,6 +28,11 @@ from parallel_cnn_tpu.config import MeshConfig
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+# Hierarchical (host, device) meshes: the outer axis over which only the
+# slow inter-host links exist. Built by make_hier_mesh; the hierarchical
+# collective (collectives.hier_all_reduce) rings each axis separately so
+# inter-host wires carry only 1/n_dev of the payload.
+HOST_AXIS = "host"
 
 
 def _resolve_shard_map():
@@ -91,6 +96,44 @@ def make_mesh(cfg: Optional[MeshConfig] = None, devices: Optional[Sequence] = No
     return Mesh(dev_array, (DATA_AXIS, MODEL_AXIS))
 
 
+def make_hier_mesh(n_hosts: Optional[int] = None,
+                   devices: Optional[Sequence] = None) -> Mesh:
+    """Build the 2-level (host, device) mesh for hierarchical collectives.
+
+    ``n_hosts=None`` derives the host axis from jax.distributed process
+    topology: one host row per process, each row that process's devices
+    (the TPU-pod case, where a row's devices share fast ICI and rows talk
+    over DCN). An explicit ``n_hosts`` instead splits the device list into
+    that many equal contiguous rows — fake hosts within one process, the
+    CPU-emulation path that lets the whole hierarchical stack run and be
+    tested on the 8-device virtual host platform.
+
+    Device order is normalized to (process_index, id) so the same mesh is
+    constructed on every participating process.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    devices.sort(key=lambda d: (d.process_index, getattr(d, "id", 0)))
+    if n_hosts is None:
+        n_hosts = len({d.process_index for d in devices})
+    n = len(devices)
+    if n_hosts < 1 or n % n_hosts != 0:
+        raise ValueError(
+            f"host axis {n_hosts} does not divide device count {n}"
+        )
+    dev_array = np.array(devices).reshape(n_hosts, n // n_hosts)
+    return Mesh(dev_array, (HOST_AXIS, DATA_AXIS))
+
+
+def hier_axis_sizes(mesh: Mesh):
+    """(n_hosts, n_devices_per_host) of a make_hier_mesh mesh."""
+    if HOST_AXIS not in mesh.axis_names:
+        raise ValueError(
+            f"mesh {mesh.axis_names} has no {HOST_AXIS!r} axis — build it "
+            "with make_hier_mesh"
+        )
+    return mesh.shape[HOST_AXIS], mesh.shape[DATA_AXIS]
+
+
 def single_device_mesh(device=None) -> Mesh:
     """A 1×1 mesh: lets every code path be written mesh-first and still run
     on one chip (≙ the Sequential/CUDA single-process backends)."""
@@ -99,9 +142,12 @@ def single_device_mesh(device=None) -> Mesh:
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Leading-axis sharding over the data axis — how epoch tensors land in
-    HBM (contrast: the CUDA reference's 60k per-sample H2D memcpys,
-    SURVEY.md §3.2)."""
+    """Leading-axis sharding over the batch-parallel axes — how epoch
+    tensors land in HBM (contrast: the CUDA reference's 60k per-sample
+    H2D memcpys, SURVEY.md §3.2). On a hierarchical (host, device) mesh
+    the batch splits over BOTH axes, host-major."""
+    if HOST_AXIS in mesh.axis_names:
+        return NamedSharding(mesh, P((HOST_AXIS, DATA_AXIS)))
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
@@ -132,6 +178,20 @@ def pad_to_multiple(n: int, k: int) -> int:
     return k * math.ceil(n / k)
 
 
+def _distributed_is_initialized() -> bool:
+    """Version-portable "has jax.distributed.initialize already run":
+    jax>=0.5 exposes jax.distributed.is_initialized(); on older releases
+    the only signal is the private global client handle."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    try:
+        from jax._src.distributed import global_state  # type: ignore
+        return global_state.client is not None
+    except ImportError:  # pragma: no cover - very old/new private layout
+        return False
+
+
 def distributed_init(coordinator: Optional[str] = None, num_processes: Optional[int] = None,
                      process_id: Optional[int] = None,
                      retry: Optional["object"] = None) -> None:
@@ -149,7 +209,7 @@ def distributed_init(coordinator: Optional[str] = None, num_processes: Optional[
     error propagates — still failing fast like MPI_Init, just not on the
     very first race with the coordinator.
     """
-    if jax.distributed.is_initialized():
+    if _distributed_is_initialized():
         return  # already initialized — idempotent by design
 
     from parallel_cnn_tpu.resilience.retry import RetryPolicy, retry_call

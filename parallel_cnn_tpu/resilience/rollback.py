@@ -57,10 +57,16 @@ class CheckpointRing:
     ``checkpoint.latest`` remains the resume-side reader.
     """
 
-    def __init__(self, directory: str, keep: int = 3, prefix: str = "ckpt_"):
+    def __init__(self, directory: str, keep: int = 3, prefix: str = "ckpt_",
+                 saver=None):
         self.directory = directory
         self.keep = keep
         self.prefix = prefix
+        # Write hook with checkpoint.save's (path, tree, state) signature.
+        # The ZeRO-3 trainer swaps in checkpoint.save_sharded (via a
+        # closure carrying world size / bucket budget) so its ring files
+        # are marked sharded and resume routes through restore_sharded.
+        self.saver = saver
 
     def path_for(self, tag: int) -> str:
         return os.path.join(self.directory, f"{self.prefix}{tag}.npz")
@@ -83,7 +89,7 @@ class CheckpointRing:
 
     def save(self, tag: int, params, state: Optional["checkpoint.TrainState"] = None) -> str:
         path = self.path_for(tag)
-        _checkpoint().save(path, params, state)
+        (self.saver or _checkpoint().save)(path, params, state)
         self._prune()
         return path
 

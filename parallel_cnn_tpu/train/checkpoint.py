@@ -47,15 +47,16 @@ def _flatten(params) -> Dict[str, np.ndarray]:
     return flat
 
 
-def save(path: str, params, state: Optional[TrainState] = None) -> None:
-    """Atomically write params (+ train state) to `path` (.npz)."""
-    state = state or TrainState()
-    meta = {
+def _meta_for(state: TrainState) -> Dict[str, Any]:
+    return {
         "version": FORMAT_VERSION,
         "epoch": state.epoch,
         "epoch_errors": state.epoch_errors,
         "extra": state.extra,
     }
+
+
+def _write_atomic(path: str, params, meta: Dict[str, Any]) -> None:
     arrays = _flatten(params)
     arrays["__meta__"] = np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8
@@ -72,6 +73,30 @@ def save(path: str, params, state: Optional[TrainState] = None) -> None:
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+
+
+def save(path: str, params, state: Optional[TrainState] = None) -> None:
+    """Atomically write params (+ train state) to `path` (.npz)."""
+    _write_atomic(path, params, _meta_for(state or TrainState()))
+
+
+def save_sharded(path: str, view, state: Optional[TrainState] = None, *,
+                 world_size: int, bucket_bytes: int) -> None:
+    """Persist a ZeRO-3 training state (same atomic .npz format).
+
+    ``view`` is the device-count-INDEPENDENT full view
+    (train/zoo.py zero3_full_view: params + momentum as ordinary pytrees
+    plus the loss-scale scalars) — NOT the resident shard rows, whose
+    bucket padding bakes the world size into every array. The metadata
+    carries a ``zero3`` marker with the world size and bucket budget that
+    produced it: restore_sharded re-shards the view for whatever mesh the
+    restoring run has (bit-exact — shard↔full is reshape/transpose/slice
+    only), and the plain restore/load_params readers refuse the file with
+    a typed error instead of mis-reading sharded state.
+    """
+    meta = _meta_for(state or TrainState())
+    meta["zero3"] = {"world_size": world_size, "bucket_bytes": bucket_bytes}
+    _write_atomic(path, view, meta)
 
 
 def _read_arrays(path: str) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
@@ -121,15 +146,27 @@ def _unflatten_into(like, stored: Dict[str, np.ndarray]):
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
 
+def _reject_sharded(path: str, meta: Dict[str, Any], reader: str) -> None:
+    if meta.get("zero3"):
+        z = meta["zero3"]
+        raise ValueError(
+            f"{path!r} is a sharded (ZeRO-3) checkpoint (world_size="
+            f"{z.get('world_size')}), use restore_sharded — "
+            f"{reader} reads unsharded trees only"
+        )
+
+
 def restore(path: str, like) -> Tuple[Any, TrainState]:
     """Load a checkpoint into the structure of `like` (a params pytree).
 
     Validates that the stored keys/shapes/dtypes exactly match `like` —
     a renamed layer or changed shape is a hard error, not a silent
     partial load. Damage and version skew raise the typed ValueError of
-    `_read_arrays`.
+    `_read_arrays`; a ZeRO-3 sharded checkpoint raises the typed
+    "use restore_sharded" error.
     """
     stored, meta = _read_arrays(path)
+    _reject_sharded(path, meta, "restore")
 
     want = _flatten(like)
     if set(stored) != set(want):
@@ -164,9 +201,13 @@ def load_params(path: str, like):
     — empty containers contribute no leaves, so their stored arrays
     become ignorable surplus. MISSING or shape/dtype-mismatched wanted
     keys still hard-error, and file damage / version skew raises the same
-    typed ValueError as `restore` (shared `_read_arrays`).
+    typed ValueError as `restore` (shared `_read_arrays`). A ZeRO-3
+    sharded checkpoint raises the typed "use restore_sharded" error —
+    its param arrays are a different tree (the full view's
+    ``params/...`` namespace), so a raw key lookup would be misleading.
     """
-    stored, _ = _read_arrays(path)
+    stored, meta = _read_arrays(path)
+    _reject_sharded(path, meta, "load_params")
     want = _flatten(like)
     missing = set(want) - set(stored)
     if missing:
@@ -175,6 +216,41 @@ def load_params(path: str, like):
         )
     _check_leaves(stored, want)
     return _unflatten_into(like, stored)
+
+
+def restore_sharded(path: str, like) -> Tuple[Any, TrainState, Dict[str, Any]]:
+    """Load a ZeRO-3 sharded checkpoint's full view into the structure of
+    ``like`` (a zero3_full_view-shaped pytree).
+
+    Returns (view, TrainState, zero3-metadata). The view is world-size
+    independent, so the SAME template matches regardless of how many
+    devices wrote the file — rebuilding resident shards for the current
+    mesh is zoo.zero3_from_view's job (reshard-on-restore). Handing this
+    reader an unsharded checkpoint is a typed ValueError, mirroring
+    restore's rejection in the other direction.
+    """
+    stored, meta = _read_arrays(path)
+    if not meta.get("zero3"):
+        raise ValueError(
+            f"{path!r} is not a sharded checkpoint (no zero3 metadata) — "
+            "use restore/load_params"
+        )
+    want = _flatten(like)
+    if set(stored) != set(want):
+        missing = set(want) - set(stored)
+        surplus = set(stored) - set(want)
+        raise ValueError(
+            f"sharded checkpoint structure mismatch: "
+            f"missing={sorted(missing)} surplus={sorted(surplus)}"
+        )
+    _check_leaves(stored, want)
+    view = _unflatten_into(like, stored)
+    state = TrainState(
+        epoch=meta["epoch"],
+        epoch_errors=list(meta["epoch_errors"]),
+        extra=dict(meta["extra"]),
+    )
+    return view, state, dict(meta["zero3"])
 
 
 def latest(directory: str, prefix: str = "ckpt_") -> Optional[str]:

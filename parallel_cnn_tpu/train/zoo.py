@@ -30,7 +30,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from parallel_cnn_tpu.nn.core import Module
-from parallel_cnn_tpu.parallel.mesh import DATA_AXIS
+from parallel_cnn_tpu.parallel.mesh import DATA_AXIS, HOST_AXIS
 
 
 @jax.tree_util.register_dataclass
@@ -428,13 +428,38 @@ def _make_comm_step(
     with the running stats pmean'd so checkpoints stay replicated; the
     epoch loss is likewise the pmean of shard losses. psum and ring run
     the SAME body, so an impl ablation isolates the collective algorithm.
+
+    On a (host, device) mesh (mesh.make_hier_mesh) the batch shards over
+    BOTH axes and impl="hierarchical" routes each bucket through the
+    two-level ring (collectives.hier_*) — intra-host RS, inter-host shard
+    exchange, intra-host AG; impl="psum" reduces over the axis pair (the
+    parity baseline that shares the mesh decomposition, hence the same
+    shard-local BN statistics). The flat impl="ring" is single-axis and
+    is rejected on a hierarchical mesh.
     """
     from parallel_cnn_tpu.parallel import collectives
     from parallel_cnn_tpu.parallel.mesh import shard_map
 
+    has_host = HOST_AXIS in mesh.axis_names
+    if comm.impl == "hierarchical" and not has_host:
+        raise ValueError(
+            "comm.impl='hierarchical' needs a (host, device) mesh — build "
+            "it with mesh.make_hier_mesh (comm.hosts / PCNN_COMM_HOSTS "
+            "emulates the host axis inside one process)"
+        )
+    if comm.impl == "ring" and has_host:
+        raise ValueError(
+            "comm.impl='ring' is the flat single-axis ring; on a "
+            "(host, device) mesh use impl='hierarchical' (or 'psum')"
+        )
     n_data = mesh.shape[DATA_AXIS]
+    n_host = mesh.shape[HOST_AXIS] if has_host else 1
+    n_total = n_host * n_data
+    raxes = (HOST_AXIS, DATA_AXIS) if has_host else DATA_AXIS
+    host_kw = dict(host_axis=HOST_AXIS, host_size=n_host) if has_host else {}
+    batch_spec = P((HOST_AXIS, DATA_AXIS)) if has_host else P(DATA_AXIS)
     wire = collectives.wire_dtype_arg(comm)
-    use_ring = comm.impl == "ring"
+    use_ring = comm.impl in ("ring", "hierarchical")
     overlap = use_ring and comm.overlap and accum_steps > 1
 
     loss_fn = _build_loss_fn(model, fused)
@@ -471,8 +496,11 @@ def _make_comm_step(
             # raw key data does. Fold in the device index so each shard
             # draws its own augmentation stream (the GSPMD path gets the
             # same effect from batch-position-dependent crop draws).
+            dev_idx = jax.lax.axis_index(DATA_AXIS)
+            if has_host:
+                dev_idx = jax.lax.axis_index(HOST_AXIS) * n_data + dev_idx
             key = jax.random.wrap_key_data(key_data)
-            key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
+            key = jax.random.fold_in(key, dev_idx)
             x = augment(key, x)
         if x.shape[0] % accum_steps:
             raise ValueError(
@@ -505,11 +533,11 @@ def _make_comm_step(
             if overlap:
                 if plan is None:
                     plan = collectives.plan_buckets(
-                        grads, comm.bucket_bytes, shards=n_data
+                        grads, comm.bucket_bytes, shards=n_total
                     )
                 shards = collectives.reduce_scatter_buckets(
                     collectives.flatten_buckets(grads, plan),
-                    DATA_AXIS, n_data, wire,
+                    DATA_AXIS, n_data, wire, **host_kw,
                 )
                 shard_acc = (
                     shards
@@ -524,18 +552,20 @@ def _make_comm_step(
                 )
         if overlap:
             buckets = collectives.all_gather_buckets(
-                shard_acc, DATA_AXIS, n_data, wire
+                shard_acc, DATA_AXIS, n_data, wire, **host_kw
             )
             grads = collectives.unflatten_buckets(buckets, plan)
         else:
-            grads = collectives.tree_all_reduce(gsum, DATA_AXIS, n_data, comm)
+            grads = collectives.tree_all_reduce(
+                gsum, DATA_AXIS, n_data, comm, **host_kw
+            )
         # Each microbatch loss/grad is a LOCAL-shard mean; the collective
-        # summed over n_data devices, so the global mean divides by both.
+        # summed over n_total devices, so the global mean divides by both.
         grads = jax.tree_util.tree_map(
-            lambda g: g / (accum_steps * n_data), grads
+            lambda g: g / (accum_steps * n_total), grads
         )
-        loss = jax.lax.pmean(lsum / accum_steps, DATA_AXIS)
-        model_state = jax.lax.pmean(model_state, DATA_AXIS)
+        loss = jax.lax.pmean(lsum / accum_steps, raxes)
+        model_state = jax.lax.pmean(model_state, raxes)
         updates, opt_state = optimizer.update(grads, state.opt_state, params)
         params = optax.apply_updates(params, updates)
         return ZooState(params, model_state, opt_state), loss
@@ -550,7 +580,7 @@ def _make_comm_step(
     )
     if augment is not None:
         sharded = shard_map(
-            shard_body, in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P()),
+            shard_body, in_specs=(P(), batch_spec, batch_spec, P()),
             **specs,
         )
 
@@ -564,7 +594,7 @@ def _make_comm_step(
 
     else:
         sharded = shard_map(
-            shard_body, in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)), **specs
+            shard_body, in_specs=(P(), batch_spec, batch_spec), **specs
         )
 
         def step(state: ZooState, x, y, key=None):
@@ -775,6 +805,328 @@ def make_fused_train_step(
     return jax.jit(step, donate_argnums=(0,))
 
 
+def init_zero3_state(
+    model: Module,
+    key: jax.Array,
+    in_shape: Tuple[int, ...],
+    *,
+    n_data: int,
+    fused,
+    bucket_bytes: int,
+    n_host: int = 1,
+):
+    """(ZooState for the ZeRO-3 step, BucketPlan).
+
+    Unlike init_fused_state (ZeRO-2: replicated params, sharded momentum),
+    BOTH params and momentum live permanently as 1/n bucket shards:
+    ``ZooState.params`` is a list of per-bucket ``(n_host*n_data, L)``
+    rows in shard_map's P((host, data)) row order
+    (collectives.hier_shard_rows — with n_host=1 that's the plain flat
+    layout), each device owning one row. The full param pytree exists only
+    transiently inside the step, rebuilt by the just-in-time all-gathers;
+    host-side consumers (eval, checkpointing) go through
+    zero3_full_params / zero3_full_view.
+    """
+    from parallel_cnn_tpu.parallel import collectives
+
+    params, model_state, _ = model.init(key, in_shape)
+    n_shards = n_host * n_data
+    plan = collectives.plan_buckets(params, bucket_bytes, shards=n_shards)
+    pshards = [
+        collectives.hier_shard_rows(b, n_host, n_data)
+        for b in collectives.flatten_buckets(params, plan)
+    ]
+    mom = [jnp.zeros(p.shape, jnp.float32) for p in pshards]
+    scale0 = fused.loss_scale if fused.act_dtype == "bfloat16" else 1.0
+    opt = FusedOptState(
+        mom=mom,
+        scale=jnp.float32(scale0),
+        good_steps=jnp.int32(0),
+        skipped=jnp.int32(0),
+    )
+    return ZooState(pshards, model_state, opt), plan
+
+
+def zero3_full_params(state: ZooState, plan, *, n_host: int = 1):
+    """Rematerialize the full param pytree from ZeRO-3 resident shards —
+    a pure reshuffle (no collectives), world-size independent and exact.
+    Host-side companion of the step's just-in-time gathers, used by eval
+    and checkpointing."""
+    from parallel_cnn_tpu.parallel import collectives
+
+    n_data = plan.shards // n_host
+    buckets = [
+        collectives.hier_unshard_rows(rows, n_host, n_data)
+        for rows in state.params
+    ]
+    return collectives.unflatten_buckets(buckets, plan)
+
+
+def zero3_full_view(state: ZooState, plan, *, n_host: int = 1):
+    """The device-count-INDEPENDENT view of a ZeRO-3 training state:
+    params and momentum as ordinary pytrees (momentum unflattened through
+    the same plan, so its leaves mirror the param structure — exact for
+    the all-f32 zoo models) plus the loss-scale scalars. This is what
+    checkpoint.save_sharded persists; restoring on a different world size
+    is just re-sharding this view (zero3_from_view) with a new plan —
+    bit-exact, because shard↔full is reshape/transpose/slice only."""
+    from parallel_cnn_tpu.parallel import collectives
+
+    n_data = plan.shards // n_host
+    mom_buckets = [
+        collectives.hier_unshard_rows(rows, n_host, n_data)
+        for rows in state.opt_state.mom
+    ]
+    return {
+        "params": zero3_full_params(state, plan, n_host=n_host),
+        "model_state": state.model_state,
+        "mom": collectives.unflatten_buckets(mom_buckets, plan),
+        "scale": state.opt_state.scale,
+        "good_steps": state.opt_state.good_steps,
+        "skipped": state.opt_state.skipped,
+    }
+
+
+def zero3_from_view(view, *, n_data: int, bucket_bytes: int,
+                    n_host: int = 1):
+    """Inverse of zero3_full_view for a (possibly different) world size:
+    re-plan the buckets for n_host*n_data shards and lay params/momentum
+    back out as resident rows. (ZooState, BucketPlan)."""
+    from parallel_cnn_tpu.parallel import collectives
+
+    params = view["params"]
+    plan = collectives.plan_buckets(params, bucket_bytes,
+                                    shards=n_host * n_data)
+    pshards = [
+        collectives.hier_shard_rows(b, n_host, n_data)
+        for b in collectives.flatten_buckets(params, plan)
+    ]
+    mom = [
+        collectives.hier_shard_rows(b, n_host, n_data).astype(jnp.float32)
+        for b in collectives.flatten_buckets(view["mom"], plan)
+    ]
+    opt = FusedOptState(
+        mom=mom,
+        scale=jnp.asarray(view["scale"], jnp.float32),
+        good_steps=jnp.asarray(view["good_steps"], jnp.int32),
+        skipped=jnp.asarray(view["skipped"], jnp.int32),
+    )
+    return ZooState(pshards, view["model_state"], opt), plan
+
+
+def make_zero3_train_step(
+    model: Module,
+    *,
+    lr: float,
+    momentum: float,
+    accum_steps: int,
+    mesh: Mesh,
+    augment: Optional[Callable],
+    comm,
+    fused,
+    plan,
+) -> Callable:
+    """ZeRO-3 train step: params never exist whole in persistent state.
+
+    Extends make_fused_train_step (ZeRO-2 update-on-arrival) in both
+    directions of the step:
+
+    - HEAD — just-in-time parameter gathering. The resident state is the
+      per-bucket shard rows of init_zero3_state; the step opens with one
+      all-gather per bucket (ALWAYS f32 on the wire — these are the
+      master weights; comm.wire_dtype compresses gradients only) and
+      unflattens the transient full pytree the microbatch loop consumes.
+      The per-bucket gathers are mutually independent and independent of
+      every other bucket's unflatten/first-use, so XLA overlaps the
+      gather of bucket k+1 with the consumption of bucket k — and, on
+      the first microbatch, with the head of forward compute.
+    - TAIL — update-on-arrival WITHOUT the trailing all-gather: bucket
+      b's fused_sgd_momentum launches the moment its reduce-scattered
+      gradient sum lands, updating the local param+momentum rows in
+      place; the updated shards ARE the next step's resident state. The
+      wire volume the ZeRO-2 step spends on its trailing param AG moves
+      to this step's head gather — per-step total is unchanged, resident
+      param memory drops to 1/n.
+
+    Works over the flat ring (comm.impl="ring") or the two-level
+    hierarchical ring (comm.impl="hierarchical" on a make_hier_mesh
+    mesh); shard rows are laid out so each device's row is exactly the
+    sub-chunk the configured ring delivers/collects (hier_shard_rows).
+    Dynamic loss scaling follows make_fused_train_step: overflow skips
+    the update via jnp.where agreement over all batch axes.
+    """
+    from parallel_cnn_tpu.ops import pallas_update
+    from parallel_cnn_tpu.parallel import collectives
+    from parallel_cnn_tpu.parallel.mesh import shard_map
+
+    if comm is None or comm.impl not in ("ring", "hierarchical"):
+        raise ValueError(
+            "ZeRO-3 requires the explicit bucketed collectives — "
+            "comm.impl='ring' or 'hierarchical'"
+        )
+    has_host = HOST_AXIS in mesh.axis_names
+    if comm.impl == "hierarchical" and not has_host:
+        raise ValueError(
+            "comm.impl='hierarchical' needs a (host, device) mesh — build "
+            "it with mesh.make_hier_mesh"
+        )
+    if comm.impl == "ring" and has_host:
+        raise ValueError(
+            "comm.impl='ring' is the flat single-axis ring; on a "
+            "(host, device) mesh use impl='hierarchical'"
+        )
+    n_data = mesh.shape[DATA_AXIS]
+    n_host = mesh.shape[HOST_AXIS] if has_host else 1
+    n_total = n_host * n_data
+    raxes = (HOST_AXIS, DATA_AXIS) if has_host else DATA_AXIS
+    host_kw = dict(host_axis=HOST_AXIS, host_size=n_host) if has_host else {}
+    batch_spec = P((HOST_AXIS, DATA_AXIS)) if has_host else P(DATA_AXIS)
+    row_spec = P((HOST_AXIS, DATA_AXIS)) if has_host else P(DATA_AXIS)
+    if plan.shards != n_total:
+        raise ValueError(
+            f"bucket plan was laid out for {plan.shards} shards but the "
+            f"mesh has {n_total} batch-parallel devices — rebuild with "
+            "init_zero3_state/zero3_from_view for this mesh"
+        )
+    wire = collectives.wire_dtype_arg(comm)
+    loss_fn = _build_loss_fn(model, fused)
+    dynamic = fused.act_dtype == "bfloat16"
+
+    def shard_body(state: ZooState, x, y, key_data=None):
+        opt = state.opt_state
+        scale = opt.scale
+        # Just-in-time parameter gathering: local shard rows -> transient
+        # full pytree. f32 wire unconditionally (master weights).
+        full_buckets = collectives.all_gather_buckets(
+            [rows[0] for rows in state.params],
+            DATA_AXIS, n_data, None, **host_kw,
+        )
+        params = collectives.unflatten_buckets(full_buckets, plan)
+        model_state = state.model_state
+        if augment is not None:
+            dev_idx = jax.lax.axis_index(DATA_AXIS)
+            if has_host:
+                dev_idx = jax.lax.axis_index(HOST_AXIS) * n_data + dev_idx
+            key = jax.random.wrap_key_data(key_data)
+            key = jax.random.fold_in(key, dev_idx)
+            x = augment(key, x)
+        if x.shape[0] % accum_steps:
+            raise ValueError(
+                f"per-device batch {x.shape[0]} must be a multiple of "
+                f"accum_steps {accum_steps} (no silent sample dropping)"
+            )
+        mb = x.shape[0] // accum_steps
+
+        def scaled(params, model_state, bx, by):
+            loss, new_state = loss_fn(params, model_state, bx, by)
+            return loss * scale, (loss, new_state)
+
+        lsum = jnp.float32(0.0)
+        shard_acc = None
+        for i in range(accum_steps):
+            bx = x[i * mb : (i + 1) * mb]
+            by = y[i * mb : (i + 1) * mb]
+            if i:
+                # shard_acc stays OUT of the barrier, exactly as in the
+                # ZeRO-2 overlap schedule.
+                bx, lsum, model_state = jax.lax.optimization_barrier(
+                    (bx, lsum, model_state)
+                )
+            grads, (loss, model_state) = jax.grad(scaled, has_aux=True)(
+                params, model_state, bx, by
+            )
+            lsum = lsum + loss  # UNSCALED loss for reporting
+            shards = collectives.reduce_scatter_buckets(
+                collectives.flatten_buckets(grads, plan),
+                DATA_AXIS, n_data, wire, **host_kw,
+            )
+            shard_acc = (
+                shards
+                if shard_acc is None
+                else [a + b for a, b in zip(shard_acc, shards)]
+            )
+        finite = jnp.stack(
+            [jnp.all(jnp.isfinite(s)) for s in shard_acc]
+        ).all()
+        ok = jax.lax.pmin(finite.astype(jnp.int32), raxes) > 0
+        gscale = 1.0 / (scale * (accum_steps * n_total))
+        new_psh = []
+        new_mom = []
+        for b, gsh in enumerate(shard_acc):
+            psh = state.params[b][0]  # sharded in: local (1, L) row
+            msh = opt.mom[b][0]
+            p_new, m_new = pallas_update.fused_sgd_momentum(
+                psh, msh, gsh, lr=lr, momentum=momentum, scale=gscale
+            )
+            # No trailing all-gather: the updated shard rows ARE the
+            # resident state the next step's head gather will collect.
+            new_psh.append(jnp.where(ok, p_new, psh)[None, :])
+            new_mom.append(jnp.where(ok, m_new, msh)[None, :])
+        new_state = jax.lax.pmean(model_state, raxes)
+        model_state = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(ok, new, old),
+            new_state, state.model_state,
+        )
+        loss = jax.lax.pmean(lsum / accum_steps, raxes)
+        if dynamic:
+            new_scale = jnp.where(
+                ok, scale, jnp.maximum(scale * fused.backoff, 1.0)
+            )
+            good = jnp.where(ok, opt.good_steps + 1, 0)
+            grow = good >= fused.growth_interval
+            new_scale = jnp.where(grow, new_scale * 2.0, new_scale)
+            good = jnp.where(grow, jnp.int32(0), good)
+        else:
+            new_scale, good = scale, opt.good_steps
+        skipped = opt.skipped + (1 - ok.astype(jnp.int32))
+        opt = FusedOptState(
+            mom=new_mom, scale=new_scale, good_steps=good, skipped=skipped
+        )
+        return ZooState(new_psh, model_state, opt), loss
+
+    state_spec = ZooState(
+        params=[row_spec] * plan.n_buckets,
+        model_state=P(),
+        opt_state=FusedOptState(
+            mom=[row_spec] * plan.n_buckets,
+            scale=P(),
+            good_steps=P(),
+            skipped=P(),
+        ),
+    )
+    specs = dict(
+        mesh=mesh,
+        out_specs=(state_spec, P()),
+        check_vma=False,  # ppermute outputs, as in _make_comm_step
+    )
+    if augment is not None:
+        sharded = shard_map(
+            shard_body,
+            in_specs=(state_spec, batch_spec, batch_spec, P()),
+            **specs,
+        )
+
+        def step(state: ZooState, x, y, key=None):
+            if key is None:
+                raise ValueError(
+                    "this train step was built with `augment`; call it as "
+                    "step(state, x, y, key) with a fresh PRNG key per step"
+                )
+            return sharded(state, x, y, jax.random.key_data(key))
+
+    else:
+        sharded = shard_map(
+            shard_body,
+            in_specs=(state_spec, batch_spec, batch_spec),
+            **specs,
+        )
+
+        def step(state: ZooState, x, y, key=None):
+            return sharded(state, x, y)
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
 def make_eval_step(model: Module) -> Callable:
     """(params, model_state, x, y) -> correct-prediction count.
 
@@ -959,13 +1311,24 @@ def train(
             f"of {batch_size}"
         )
     if fused is not None and fused.update:
-        if mesh is None or comm is None or comm.impl != "ring":
+        if (mesh is None or comm is None
+                or comm.impl not in ("ring", "hierarchical")):
             if verbose:
                 print(
                     "fused-step: update-on-arrival needs mesh + "
-                    "comm.impl='ring'; falling back to fused tail only"
+                    "comm.impl='ring'/'hierarchical'; falling back to "
+                    "fused tail only"
                 )
-            fused = dataclasses.replace(fused, update=False)
+            # zero=3 requires update=True (config invariant) — the
+            # fallback drops both together.
+            fused = dataclasses.replace(fused, update=False, zero=2)
+        elif comm.impl == "hierarchical" and fused.zero != 3:
+            raise ValueError(
+                "ZeRO-2 update-on-arrival rides the flat ring; on a "
+                "hierarchical mesh use fused.zero=3 (whose resident "
+                "shards follow the two-level ring), or comm.impl='ring' "
+                "on a flat mesh"
+            )
         elif model_axis:
             raise ValueError(
                 "fused.update is the explicit data-parallel path; "
@@ -978,7 +1341,18 @@ def train(
                 "(set update=False)"
             )
     use_fused_update = fused is not None and fused.update
-    if use_fused_update:
+    use_zero3 = use_fused_update and fused.zero == 3
+    z3_plan = None
+    z3_host = 1
+    if use_zero3:
+        if HOST_AXIS in mesh.axis_names:
+            z3_host = mesh.shape[HOST_AXIS]
+        state, z3_plan = init_zero3_state(
+            model, jax.random.key(seed), in_shape,
+            n_data=mesh.shape[DATA_AXIS], fused=fused,
+            bucket_bytes=comm.bucket_bytes, n_host=z3_host,
+        )
+    elif use_fused_update:
         state, n_buckets = init_fused_state(
             model, jax.random.key(seed), in_shape,
             n_data=mesh.shape[DATA_AXIS], fused=fused,
@@ -998,7 +1372,13 @@ def train(
         def aug_fn(key, x):
             return aug_lib.random_crop_flip(key, x, pad=augment_pad)
 
-    if use_fused_update:
+    if use_zero3:
+        step = make_zero3_train_step(
+            model, lr=lr, momentum=momentum, accum_steps=accum_steps,
+            mesh=mesh, augment=aug_fn, comm=comm, fused=fused,
+            plan=z3_plan,
+        )
+    elif use_fused_update:
         step = make_fused_train_step(
             model, lr=lr, momentum=momentum, accum_steps=accum_steps,
             mesh=mesh, augment=aug_fn, comm=comm, fused=fused,
@@ -1050,8 +1430,25 @@ def train(
         controller = RollbackController(max_rollbacks=res.max_rollbacks)
     ring = None
     if checkpoint_dir:
+        saver = None
+        if use_zero3:
+            from parallel_cnn_tpu.train import checkpoint
+
+            world = z3_host * mesh.shape[DATA_AXIS]
+
+            def saver(path, st, tstate):
+                # Ring files carry the world-size-independent full view,
+                # marked sharded so resume re-shards for the new mesh and
+                # plain restore/load_params refuse with the typed error.
+                checkpoint.save_sharded(
+                    path, zero3_full_view(st, z3_plan, n_host=z3_host),
+                    tstate, world_size=world,
+                    bucket_bytes=comm.bucket_bytes,
+                )
+
         ring = CheckpointRing(
-            checkpoint_dir, keep=res.ring_size if res is not None else 0
+            checkpoint_dir, keep=res.ring_size if res is not None else 0,
+            saver=saver,
         )
 
     start_epoch = 0
@@ -1062,9 +1459,20 @@ def train(
 
         path = checkpoint.latest(checkpoint_dir)
         if path:
-            # `state` is the restore template: full-state structure
-            # (params + opt_state + BN stats) validated leaf-for-leaf.
-            state, tstate = checkpoint.restore(path, state)
+            if use_zero3:
+                # Sharded resume: restore the world-size-independent view
+                # and re-shard it for THIS run's mesh (reshard-on-restore
+                # — the writing run's world size is irrelevant).
+                template = zero3_full_view(state, z3_plan, n_host=z3_host)
+                view, tstate, _ = checkpoint.restore_sharded(path, template)
+                state, z3_plan = zero3_from_view(
+                    view, n_data=mesh.shape[DATA_AXIS],
+                    bucket_bytes=comm.bucket_bytes, n_host=z3_host,
+                )
+            else:
+                # `state` is the restore template: full-state structure
+                # (params + opt_state + BN stats) validated leaf-for-leaf.
+                state, tstate = checkpoint.restore(path, state)
             start_epoch = tstate.epoch
             losses = list(tstate.epoch_errors)
             accs = list(tstate.extra.get("epoch_accs", []))
@@ -1157,8 +1565,16 @@ def train(
         losses.append(mean_loss)
         seconds = time.perf_counter() - t0
         if eval_data is not None:
+            est = state
+            if use_zero3:
+                # Eval consumes the full param pytree; rematerialize it
+                # from the resident shards (pure reshuffle, no comm).
+                est = ZooState(
+                    zero3_full_params(state, z3_plan, n_host=z3_host),
+                    state.model_state, None,
+                )
             accs.append(
-                evaluate(model, state, *eval_data,
+                evaluate(model, est, *eval_data,
                          batch_size=eval_batch_size, eval_step=ev_step)
             )
         if metrics is not None:

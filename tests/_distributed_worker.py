@@ -15,10 +15,16 @@ tests/conftest.py), joins the coordination service, and runs:
    the single-process run bit-for-bit-to-tolerance.
 3. The same three steps on a hybrid 2-D (data, model) mesh whose MODEL
    axis is interleaved ACROSS the two processes — every activation and
-   shared-kernel-grad psum is a cross-process collective.
+   shared-kernel-grad psum is a real cross-process collective.
+4. Three zoo steps over the REAL (host, device) mesh derived from the
+   process topology with comm.impl="hierarchical" — the inter-host ring
+   hops are genuine cross-process ppermutes over the host axis.
+5. The same three steps under ZeRO-3 (make_zero3_train_step): resident
+   param/momentum shards are distributed over both processes and the
+   just-in-time head gathers cross the process boundary every step.
 
-Prints parseable RESULT / TRAIN / TRAIN2D lines for the parent to assert
-on.
+Prints parseable RESULT / TRAIN / TRAIN2D / TRAINHIER / TRAINZ3 lines
+for the parent to assert on.
 """
 
 import os
@@ -33,6 +39,12 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
+# Cross-process collectives on the CPU backend go through gloo; the
+# default ("none") hard-errors on the first multiprocess computation.
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:  # newer jax: gloo is the default and the knob is gone
+    pass
 
 import numpy as np  # noqa: E402
 
@@ -129,6 +141,114 @@ def train_trajectory_2d():
     return errs
 
 
+# Mirrors tests/test_collectives.py's tiny_model / test_aux.py's parity
+# reference — duplicated here because importing this module would run its
+# jax.config mutations in the importer.
+TINY_SHAPE = (8, 8, 3)
+
+
+def _tiny_model():
+    from parallel_cnn_tpu.nn import core, layers
+
+    return core.Sequential([
+        layers.Conv2D(4, (3, 3)), layers.BatchNorm(), layers.ReLU(),
+        layers.MaxPool(), layers.Flatten(), layers.Dense(10),
+    ])
+
+
+def _tiny_data():
+    rng = np.random.default_rng(456)
+    xs = rng.normal(
+        size=(TRAIN_STEPS, GLOBAL_BATCH) + TINY_SHAPE
+    ).astype(np.float32)
+    ys = rng.integers(0, 10, (TRAIN_STEPS, GLOBAL_BATCH)).astype(np.int32)
+    return xs, ys
+
+
+def train_trajectory_hier():
+    """Three zoo steps over the real 2-process (host, device) mesh with the
+    hierarchical two-level rings: intra-host hops stay process-local, the
+    host-axis shard exchange is a cross-process ppermute."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from parallel_cnn_tpu.config import CommConfig
+    from parallel_cnn_tpu.parallel import mesh as mesh_lib
+    from parallel_cnn_tpu.train import zoo
+
+    mesh = mesh_lib.make_hier_mesh()  # host rows == the two real processes
+    rep = NamedSharding(mesh, P())
+    dat = mesh_lib.batch_sharding(mesh)
+
+    model = _tiny_model()
+    opt = zoo.make_optimizer(lr=0.05)
+    st = zoo.init_state(model, jax.random.key(7), TINY_SHAPE, opt)
+    st = jax.tree_util.tree_map(lambda a: _globalize(mesh, a, rep), st)
+    step = zoo.make_train_step(
+        model, opt, accum_steps=2, mesh=mesh,
+        comm=CommConfig(impl="hierarchical", bucket_bytes=2048),
+    )
+    xs, ys = _tiny_data()
+    losses = []
+    for i in range(TRAIN_STEPS):
+        st, l = step(
+            st, _globalize(mesh, xs[i], dat), _globalize(mesh, ys[i], dat)
+        )
+        losses.append(float(l))
+    return losses
+
+
+def train_trajectory_zero3():
+    """The same three steps under ZeRO-3 over the hierarchical rings —
+    every device owns 1/8 of params+momentum, half of each bucket's rows
+    living in the OTHER process; the step-head param gathers and the
+    gradient reduce-scatters both cross the process boundary."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from parallel_cnn_tpu.config import CommConfig, FusedStepConfig
+    from parallel_cnn_tpu.parallel import mesh as mesh_lib
+    from parallel_cnn_tpu.train import zoo
+
+    mesh = mesh_lib.make_hier_mesh()
+    n_host, n_dev = mesh_lib.hier_axis_sizes(mesh)
+    rep = NamedSharding(mesh, P())
+    row = NamedSharding(
+        mesh, P((mesh_lib.HOST_AXIS, mesh_lib.DATA_AXIS))
+    )
+    dat = mesh_lib.batch_sharding(mesh)
+
+    model = _tiny_model()
+    comm = CommConfig(impl="hierarchical", bucket_bytes=2048)
+    fused = FusedStepConfig(update=True, tail=True, zero=3)
+    st, plan = zoo.init_zero3_state(
+        model, jax.random.key(7), TINY_SHAPE, n_data=n_dev, fused=fused,
+        bucket_bytes=comm.bucket_bytes, n_host=n_host,
+    )
+    st = zoo.ZooState(
+        [_globalize(mesh, p, row) for p in st.params],
+        jax.tree_util.tree_map(
+            lambda a: _globalize(mesh, a, rep), st.model_state
+        ),
+        zoo.FusedOptState(
+            mom=[_globalize(mesh, m, row) for m in st.opt_state.mom],
+            scale=_globalize(mesh, st.opt_state.scale, rep),
+            good_steps=_globalize(mesh, st.opt_state.good_steps, rep),
+            skipped=_globalize(mesh, st.opt_state.skipped, rep),
+        ),
+    )
+    step = zoo.make_zero3_train_step(
+        model, lr=0.05, momentum=0.9, accum_steps=2, mesh=mesh,
+        augment=None, comm=comm, fused=fused, plan=plan,
+    )
+    xs, ys = _tiny_data()
+    losses = []
+    for i in range(TRAIN_STEPS):
+        st, l = step(
+            st, _globalize(mesh, xs[i], dat), _globalize(mesh, ys[i], dat)
+        )
+        losses.append(float(l))
+    return losses
+
+
 def main() -> int:
     joined = distributed.initialize()
     assert joined, "PCNN_* env must configure a 2-process run"
@@ -152,6 +272,12 @@ def main() -> int:
 
     errs2d = train_trajectory_2d()
     print("TRAIN2D", ",".join(f"{e:.8e}" for e in errs2d), flush=True)
+
+    hier = train_trajectory_hier()
+    print("TRAINHIER", ",".join(f"{e:.8e}" for e in hier), flush=True)
+
+    z3 = train_trajectory_zero3()
+    print("TRAINZ3", ",".join(f"{e:.8e}" for e in z3), flush=True)
     return 0
 
 

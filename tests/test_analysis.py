@@ -107,6 +107,65 @@ def test_ring_permutation_clean_on_single_cycle(mesh4):
     )
 
 
+def test_ring_permutation_trips_on_partial_axis_coverage(mesh4):
+    # A perfectly valid single cycle — over only 3 of the axis's 4
+    # ranks. Rank 3 never contributes or receives the reduction; only
+    # the axis-size-aware check sees it.
+    partial = [(0, 1), (1, 2), (2, 0)]
+    closed = _shmap_jaxpr(
+        mesh4, lambda v: lax.ppermute(v, "data", partial),
+        jnp.ones((4, 2), jnp.float32),
+    )
+    hits = _by_rule(
+        jaxpr_rules.analyze_closed_jaxpr("fixture", closed),
+        "ring-permutation",
+    )
+    assert hits and "every rank of its axis" in hits[0].message
+
+
+@pytest.fixture(scope="module")
+def hier_mesh22(host_devices):
+    return mesh_lib.make_hier_mesh(n_hosts=2, devices=host_devices[:4])
+
+
+def _hier_jaxpr(mesh, body, x):
+    f = mesh_lib.shard_map(
+        body, mesh=mesh, in_specs=P(("host", "data")),
+        out_specs=P(("host", "data")), check_vma=False,
+    )
+    return jax.make_jaxpr(f)(x)
+
+
+def test_ring_permutation_clean_on_per_axis_hier_rings(hier_mesh22):
+    ring2 = [(i, (i + 1) % 2) for i in range(2)]
+
+    def hier(v):
+        v = lax.ppermute(v, "data", ring2)   # intra-host ring
+        return lax.ppermute(v, "host", ring2)  # inter-host ring
+
+    closed = _hier_jaxpr(hier_mesh22, hier, jnp.ones((4, 2), jnp.float32))
+    assert not _by_rule(
+        jaxpr_rules.analyze_closed_jaxpr("fixture", closed),
+        "ring-permutation",
+    )
+
+
+def test_ring_permutation_trips_on_global_ranks_in_hier_axis(hier_mesh22):
+    # The classic flat-to-hierarchical port bug: a ring written over
+    # GLOBAL ranks 0..3 issued on one axis of a 2x2 (host, device) mesh.
+    # Within the 2-wide axis, ranks 2 and 3 don't exist.
+    ring4 = [(i, (i + 1) % 4) for i in range(4)]
+    closed = _hier_jaxpr(
+        hier_mesh22, lambda v: lax.ppermute(v, "data", ring4),
+        jnp.ones((4, 2), jnp.float32),
+    )
+    hits = _by_rule(
+        jaxpr_rules.analyze_closed_jaxpr("fixture", closed),
+        "ring-permutation",
+    )
+    assert hits and "axis 'data' (size 2)" in hits[0].message
+
+
 def test_f32_wire_trips_on_bf16_param_gather(mesh4):
     ring = [(i, (i + 1) % 4) for i in range(4)]
 
@@ -127,12 +186,44 @@ def test_f32_wire_clean_on_f32_gather_and_bf16_grad(mesh4):
 
     def mixed(v):
         gathered = lax.ppermute(v, "data", ring)  # f32 wire: fine
-        # bf16 GRADIENT wire: exempt by construction — optimizer
-        # arithmetic (the add) breaks the transparent chain.
-        g = lax.ppermute(v.astype(jnp.bfloat16), "data", ring)
+        # bf16 GRADIENT wire: exempt by construction — a gradient is
+        # produced by backward-pass arithmetic (the square) and consumed
+        # by optimizer arithmetic (the add), so the transparent chain is
+        # broken on both the input and output side.
+        g = lax.ppermute((v * v).astype(jnp.bfloat16), "data", ring)
         return gathered + g.astype(jnp.float32) * 0.1
 
     closed = _shmap_jaxpr(mesh4, mixed, jnp.ones((4, 2), jnp.float32))
+    assert not _by_rule(
+        jaxpr_rules.analyze_closed_jaxpr("fixture", closed), "f32-wire"
+    )
+
+
+def test_f32_wire_trips_on_bf16_resident_gather(mesh4):
+    ring = [(i, (i + 1) % 4) for i in range(4)]
+
+    def head_gather(v):
+        # ZeRO-3-shaped violation: resident shards (a jaxpr INPUT) cast
+        # to bf16 and gathered, then consumed by step arithmetic — the
+        # output-side slice never sees the wire, only the input-side
+        # slice catches it.
+        g = lax.ppermute(v.astype(jnp.bfloat16), "data", ring)
+        return g.astype(jnp.float32) * 2.0
+
+    closed = _shmap_jaxpr(mesh4, head_gather, jnp.ones((4, 2), jnp.float32))
+    hits = _by_rule(
+        jaxpr_rules.analyze_closed_jaxpr("fixture", closed), "f32-wire"
+    )
+    assert hits and "fed from a jaxpr input" in hits[0].message
+
+
+def test_f32_wire_clean_on_f32_resident_gather(mesh4):
+    ring = [(i, (i + 1) % 4) for i in range(4)]
+
+    def head_gather(v):
+        return lax.ppermute(v, "data", ring) * 2.0
+
+    closed = _shmap_jaxpr(mesh4, head_gather, jnp.ones((4, 2), jnp.float32))
     assert not _by_rule(
         jaxpr_rules.analyze_closed_jaxpr("fixture", closed), "f32-wire"
     )

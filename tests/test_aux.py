@@ -310,6 +310,62 @@ def test_two_process_distributed_smoke(tmp_path):
     assert trains2d[0] == trains2d[1]
     np.testing.assert_allclose(trains2d[0], ref_errs, rtol=1e-4)
 
+    # Hierarchical comm step + ZeRO-3 over the REAL 2-process (host,
+    # device) mesh: both ranks agree, and both trajectories match the
+    # single-process zoo steps on the EMULATED 2x4 hier mesh (this
+    # process's 8 devices) — same mesh decomposition, so the only
+    # difference is which transport the host-axis ring hops cross.
+    def _tagged(tag):
+        vals = []
+        for out in outs:
+            line = [l for l in out.splitlines() if l.split()[:1] == [tag]][0]
+            vals.append([float(v) for v in line.split()[1].split(",")])
+        assert vals[0] == vals[1], f"{tag}: ranks diverged"
+        return vals[0]
+
+    hier, z3 = _tagged("TRAINHIER"), _tagged("TRAINZ3")
+
+    from parallel_cnn_tpu.config import CommConfig, FusedStepConfig
+    from parallel_cnn_tpu.nn import core, layers
+    from parallel_cnn_tpu.parallel import mesh as mesh_lib
+    from parallel_cnn_tpu.train import zoo
+
+    tiny_shape = (8, 8, 3)  # mirrors the worker's _tiny_model/_tiny_data
+    model = core.Sequential([
+        layers.Conv2D(4, (3, 3)), layers.BatchNorm(), layers.ReLU(),
+        layers.MaxPool(), layers.Flatten(), layers.Dense(10),
+    ])
+    rng2 = np.random.default_rng(456)
+    xs2 = rng2.normal(size=(n, b) + tiny_shape).astype(np.float32)
+    ys2 = rng2.integers(0, 10, (n, b)).astype(np.int32)
+    hmesh = mesh_lib.make_hier_mesh(n_hosts=2)
+    comm = CommConfig(impl="hierarchical", bucket_bytes=2048, hosts=2)
+
+    opt = zoo.make_optimizer(lr=0.05)
+    st = zoo.init_state(model, jax.random.key(7), tiny_shape, opt)
+    hstep = zoo.make_train_step(model, opt, accum_steps=2, mesh=hmesh,
+                                comm=comm)
+    ref_hier = []
+    for i in range(n):
+        st, l = hstep(st, jnp.asarray(xs2[i]), jnp.asarray(ys2[i]))
+        ref_hier.append(float(l))
+    np.testing.assert_allclose(hier, ref_hier, rtol=1e-5, atol=1e-6)
+
+    fused = FusedStepConfig(update=True, tail=True, zero=3)
+    zst, plan = zoo.init_zero3_state(
+        model, jax.random.key(7), tiny_shape, n_data=4, fused=fused,
+        bucket_bytes=comm.bucket_bytes, n_host=2,
+    )
+    zstep = zoo.make_zero3_train_step(
+        model, lr=0.05, momentum=0.9, accum_steps=2, mesh=hmesh,
+        augment=None, comm=comm, fused=fused, plan=plan,
+    )
+    ref_z3 = []
+    for i in range(n):
+        zst, l = zstep(zst, jnp.asarray(xs2[i]), jnp.asarray(ys2[i]))
+        ref_z3.append(float(l))
+    np.testing.assert_allclose(z3, ref_z3, rtol=1e-5, atol=1e-6)
+
 
 def test_cli_zoo_profile_writes_trace(tmp_path):
     """Zoo --profile captures a jax.profiler trace of steady-state steps

@@ -202,6 +202,149 @@ class TestRingCollectives:
         assert tree_allclose(ref, out, atol=1e-5)
 
 
+@pytest.fixture(scope="module")
+def hier_mesh(host_devices):
+    """2 emulated hosts x 4 devices — the CPU stand-in for a 2-process
+    pod slice (same mesh axes, same per-axis rings)."""
+    return mesh_lib.make_hier_mesh(n_hosts=2)
+
+
+def _run_hier(mesh, body, x, out_specs=P(), check=False):
+    f = mesh_lib.shard_map(
+        body, mesh=mesh,
+        in_specs=(P((mesh_lib.HOST_AXIS, AXIS)),), out_specs=out_specs,
+        check_vma=check,
+    )
+    return jax.jit(f)(x)
+
+
+class TestHierarchicalCollectives:
+    NH, ND = 2, 4
+    N = NH * ND
+
+    def test_hier_allreduce_matches_psum(self, hier_mesh, rng):
+        x = jnp.asarray(rng.normal(size=(self.N * 320,)).astype(np.float32))
+        ref = _run_hier(
+            hier_mesh,
+            lambda s: jax.lax.psum(s, (mesh_lib.HOST_AXIS, AXIS)), x,
+            check=True,
+        )
+        out = _run_hier(
+            hier_mesh,
+            lambda s: collectives.hier_all_reduce(
+                s, mesh_lib.HOST_AXIS, self.NH, AXIS, self.ND
+            ),
+            x,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-6, atol=1e-5
+        )
+
+    def test_hier_rs_ag_compose(self, hier_mesh, rng):
+        x = jnp.asarray(rng.normal(size=(self.N * 80,)).astype(np.float32))
+        ref = _run_hier(
+            hier_mesh,
+            lambda s: jax.lax.psum(s, (mesh_lib.HOST_AXIS, AXIS)), x,
+            check=True,
+        )
+
+        def rs_ag(s):
+            shard = collectives.hier_reduce_scatter(
+                s, mesh_lib.HOST_AXIS, self.NH, AXIS, self.ND
+            )
+            return collectives.hier_all_gather(
+                shard, mesh_lib.HOST_AXIS, self.NH, AXIS, self.ND
+            )
+
+        out = _run_hier(hier_mesh, rs_ag, x)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-6, atol=1e-5
+        )
+
+    def test_hier_rs_placement_matches_shard_rows(self, hier_mesh):
+        """The resident-shard layout contract ZeRO-3 relies on: stacking
+        each device's reduce-scattered chunk in P((host, data)) row order
+        reproduces hier_shard_rows of the full reduction, exactly (integer
+        payload — addition associates)."""
+        x = jnp.arange(self.N * 16, dtype=jnp.int32)
+
+        def rs(s):
+            shard = collectives.hier_reduce_scatter(
+                s, mesh_lib.HOST_AXIS, self.NH, AXIS, self.ND
+            )
+            return shard[None, :]
+
+        rows = _run_hier(
+            hier_mesh, rs, x, out_specs=P((mesh_lib.HOST_AXIS, AXIS)),
+        )
+        # in_specs splits x into N distinct per-device shards; the
+        # reduction sums them elementwise, then the scatter lays the sum
+        # out exactly as hier_shard_rows does.
+        summed = jnp.asarray(np.asarray(x).reshape(self.N, -1).sum(axis=0))
+        want = collectives.hier_shard_rows(summed, self.NH, self.ND)
+        np.testing.assert_array_equal(np.asarray(rows), np.asarray(want))
+
+    def test_shard_rows_round_trip(self, rng):
+        bucket = jnp.asarray(rng.normal(size=(48,)).astype(np.float32))
+        for nh, nd in ((1, 4), (2, 4), (4, 2), (2, 2)):
+            rows = collectives.hier_shard_rows(bucket, nh, nd)
+            assert rows.shape == (nh * nd, 48 // (nh * nd))
+            back = collectives.hier_unshard_rows(rows, nh, nd)
+            np.testing.assert_array_equal(np.asarray(back), np.asarray(bucket))
+
+    def test_shard_rows_rejects_indivisible(self):
+        with pytest.raises(ValueError, match="divide"):
+            collectives.hier_shard_rows(jnp.zeros((10,)), 2, 2)
+
+    def test_tree_all_reduce_hier_matches_psum(self, hier_mesh, rng):
+        def make_tree(s):
+            return {"a": s[:37], "b": s[37:40] * 2.0, "c": s[40] * 3.0}
+
+        comm = CommConfig(impl="hierarchical", bucket_bytes=64, hosts=2)
+        x = jnp.asarray(rng.normal(size=(self.N * 41,)).astype(np.float32))
+        ref = _run_hier(
+            hier_mesh,
+            lambda s: jax.lax.psum(make_tree(s),
+                                   (mesh_lib.HOST_AXIS, AXIS)),
+            x, check=True,
+        )
+        out = _run_hier(
+            hier_mesh,
+            lambda s: collectives.tree_all_reduce(
+                make_tree(s), AXIS, self.ND, comm,
+                host_axis=mesh_lib.HOST_AXIS, host_size=self.NH,
+            ),
+            x,
+        )
+        assert tree_allclose(ref, out, atol=1e-5)
+
+    def test_tree_all_reduce_hier_requires_host_axis(self):
+        comm = CommConfig(impl="hierarchical")
+        with pytest.raises(ValueError, match="host"):
+            collectives.tree_all_reduce(
+                {"a": jnp.zeros((8,))}, AXIS, 8, comm
+            )
+
+    def test_hier_bf16_wire_close_to_f32(self, hier_mesh, rng):
+        x = jnp.asarray(rng.normal(size=(self.N * 160,)).astype(np.float32))
+        ref = _run_hier(
+            hier_mesh,
+            lambda s: jax.lax.psum(s, (mesh_lib.HOST_AXIS, AXIS)), x,
+            check=True,
+        )
+        out = _run_hier(
+            hier_mesh,
+            lambda s: collectives.hier_all_reduce(
+                s, mesh_lib.HOST_AXIS, self.NH, AXIS, self.ND,
+                wire_dtype="bfloat16",
+            ),
+            x,
+        )
+        scale = float(np.max(np.abs(np.asarray(ref))))
+        err = float(np.max(np.abs(np.asarray(out) - np.asarray(ref))))
+        assert err / scale < 2e-2
+
+
 def tiny_model():
     from parallel_cnn_tpu.nn import core, layers
 
@@ -300,6 +443,52 @@ class TestExplicitCommStep:
         with pytest.raises(ValueError, match="model_axis"):
             zoo.make_train_step(
                 model, opt, mesh=mesh8, model_axis=True, comm=CommConfig()
+            )
+
+
+class TestHierarchicalCommStep:
+    """The zoo step over the two-level rings, end to end. Parity baseline
+    is psum ON THE SAME (host, device) mesh — identical batch
+    decomposition, so BN's shard-local batch stats see the same shards
+    and the only difference left is the collective algorithm."""
+
+    def test_hier_matches_psum_loss_and_params(self, hier_mesh, rng):
+        x, y = tiny_batch(rng)
+        st_p, loss_p = run_zoo_steps(
+            hier_mesh, CommConfig(impl="psum"), x, y
+        )
+        st_h, loss_h = run_zoo_steps(
+            hier_mesh,
+            CommConfig(impl="hierarchical", bucket_bytes=2048, hosts=2),
+            x, y,
+        )
+        assert abs(loss_h - loss_p) <= 1e-5
+        assert tree_allclose(st_h.params, st_p.params, atol=1e-5)
+        assert tree_allclose(st_h.model_state, st_p.model_state, atol=1e-5)
+
+    def test_hier_bf16_wire_end_to_end_loss_parity(self, hier_mesh, rng):
+        x, y = tiny_batch(rng)
+        _, loss_p = run_zoo_steps(hier_mesh, CommConfig(impl="psum"), x, y)
+        _, loss_b = run_zoo_steps(
+            hier_mesh,
+            CommConfig(impl="hierarchical", bucket_bytes=2048,
+                       wire_dtype="bfloat16", hosts=2),
+            x, y,
+        )
+        assert abs(loss_b - loss_p) <= 1e-2
+
+    def test_hierarchical_requires_host_mesh(self, mesh8, rng):
+        x, y = tiny_batch(rng)
+        with pytest.raises(ValueError, match="host"):
+            run_zoo_steps(
+                mesh8, CommConfig(impl="hierarchical"), x, y, steps=1
+            )
+
+    def test_ring_rejected_on_host_mesh(self, hier_mesh, rng):
+        x, y = tiny_batch(rng)
+        with pytest.raises(ValueError, match="hierarchical"):
+            run_zoo_steps(
+                hier_mesh, CommConfig(impl="ring"), x, y, steps=1
             )
 
 

@@ -497,6 +497,219 @@ class TestFusedZooStep:
 
 
 # ---------------------------------------------------------------------------
+# ZeRO-3: just-in-time parameter gathering (train/zoo.py zero3_*)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def hier_mesh(host_devices):
+    return mesh_lib.make_hier_mesh(n_hosts=2)
+
+
+def _run_zero3(mesh, x, y, steps=3, lr=0.05, momentum=0.9,
+               act_dtype="float32", impl="ring", hosts=1):
+    model = _tiny_model()
+    comm = CommConfig(
+        impl=impl, bucket_bytes=2048, overlap=True,
+        hosts=hosts if impl == "hierarchical" else None,
+    )
+    fused = FusedStepConfig(update=True, tail=True, act_dtype=act_dtype,
+                            zero=3)
+    n_host = hosts if impl == "hierarchical" else 1
+    st, plan = zoo.init_zero3_state(
+        model, jax.random.key(7), TINY_SHAPE, n_data=8 // n_host,
+        fused=fused, bucket_bytes=comm.bucket_bytes, n_host=n_host,
+    )
+    step = zoo.make_zero3_train_step(
+        model, lr=lr, momentum=momentum, accum_steps=2, mesh=mesh,
+        augment=None, comm=comm, fused=fused, plan=plan,
+    )
+    losses = []
+    for _ in range(steps):
+        st, loss = step(st, x, y)
+        losses.append(float(loss))
+    return st, plan, losses
+
+
+def _f32_view_tree():
+    """All-f32 params-like tree with the bucketizer's hard shapes:
+    scalars, odd lengths, an empty leaf, nesting."""
+    return {
+        "conv": {"w": jnp.arange(7 * 3 * 5, dtype=jnp.float32).reshape(7, 3, 5),
+                 "b": jnp.arange(13, dtype=jnp.float32) * 0.5},
+        "scalar": jnp.float32(3.25),
+        "empty": jnp.zeros((0, 4), jnp.float32),
+        "odd": [jnp.linspace(-1.0, 1.0, 9, dtype=jnp.float32),
+                (jnp.full((2, 2), -2.0, jnp.float32),)],
+    }
+
+
+class TestZero3Step:
+    def test_zero3_matches_zero2_losses_and_params(self, mesh8, rng):
+        x, y = _tiny_batch(rng)
+        st2, base = _run_fused_update(mesh8, x, y)
+        st3, plan, z3 = _run_zero3(mesh8, x, y)
+        # Same microbatch schedule, same update-on-arrival kernels — the
+        # only move is WHEN the param all-gather runs (tail -> head).
+        assert max(abs(a - b) for a, b in zip(base, z3)) <= 1e-6
+        full = zoo.zero3_full_params(st3, plan)
+        assert tree_allclose(st2.params, full, atol=1e-5)
+        assert tree_allclose(st2.model_state, st3.model_state, atol=1e-5)
+
+    def test_zero3_hier_matches_flat(self, mesh8, hier_mesh, rng):
+        x, y = _tiny_batch(rng)
+        _, _, flat = _run_zero3(mesh8, x, y)
+        _, _, hier = _run_zero3(
+            hier_mesh, x, y, impl="hierarchical", hosts=2
+        )
+        assert max(abs(a - b) for a, b in zip(flat, hier)) <= 1e-5
+
+    def test_zero3_bf16_within_bound(self, mesh8, rng):
+        x, y = _tiny_batch(rng)
+        _, base = _run_unfused(mesh8, x, y)
+        _, _, z3 = _run_zero3(mesh8, x, y, act_dtype="bfloat16")
+        assert max(abs(a - b) for a, b in zip(base, z3)) <= 1e-2
+
+    def test_resident_state_is_sharded(self, mesh8, rng):
+        x, y = _tiny_batch(rng)
+        st, plan, _ = _run_zero3(mesh8, x, y, steps=1)
+        for rows, mom in zip(st.params, st.opt_state.mom):
+            assert rows.shape[0] == plan.shards == 8
+            assert mom.shape == rows.shape
+
+    def test_zero3_overflow_skips_bit_exactly(self, mesh8, rng):
+        x, y = _tiny_batch(rng)
+        model = _tiny_model()
+        comm = CommConfig(**_COMM)
+        fused = FusedStepConfig(update=True, tail=True,
+                                act_dtype="bfloat16", zero=3)
+        st, plan = zoo.init_zero3_state(
+            model, jax.random.key(7), TINY_SHAPE, n_data=8, fused=fused,
+            bucket_bytes=comm.bucket_bytes,
+        )
+        step = zoo.make_zero3_train_step(
+            model, lr=0.05, momentum=0.9, accum_steps=2, mesh=mesh8,
+            augment=None, comm=comm, fused=fused, plan=plan,
+        )
+        scale0 = float(st.opt_state.scale)
+        p0 = tree_copy(st.params)
+        st, _ = step(st, x.at[0, 0, 0, 0].set(jnp.inf), y)
+        assert tree_bitequal(st.params, p0)
+        assert all(bool(jnp.all(m == 0)) for m in st.opt_state.mom)
+        assert float(st.opt_state.scale) == scale0 * fused.backoff
+        assert int(st.opt_state.skipped) == 1
+
+    def test_zero3_requires_explicit_collectives(self, mesh8):
+        model = _tiny_model()
+        fused = FusedStepConfig(update=True, tail=True, zero=3)
+        st, plan = zoo.init_zero3_state(
+            model, jax.random.key(7), TINY_SHAPE, n_data=8, fused=fused,
+            bucket_bytes=2048,
+        )
+        with pytest.raises(ValueError, match="ring"):
+            zoo.make_zero3_train_step(
+                model, lr=0.05, momentum=0.9, accum_steps=2, mesh=mesh8,
+                augment=None, comm=CommConfig(impl="psum"), fused=fused,
+                plan=plan,
+            )
+
+    def test_zero_level_config_gating(self):
+        with pytest.raises(ValueError, match="update"):
+            FusedStepConfig(update=False, zero=3)
+        with pytest.raises(ValueError, match="zero"):
+            FusedStepConfig(update=True, zero=1)
+
+
+class TestZero3Views:
+    def test_view_round_trip_is_bit_exact_across_world_sizes(self):
+        view = {
+            "params": _f32_view_tree(),
+            "model_state": {"bn": jnp.linspace(0.0, 1.0, 4)},
+            "mom": jax.tree_util.tree_map(
+                lambda l: l * 0.25, _f32_view_tree()
+            ),
+            "scale": jnp.float32(8.0),
+            "good_steps": jnp.int32(5),
+            "skipped": jnp.int32(1),
+        }
+        for n_host, n_data in ((1, 8), (2, 4), (1, 4), (4, 2)):
+            st, plan = zoo.zero3_from_view(
+                view, n_data=n_data, bucket_bytes=64, n_host=n_host
+            )
+            assert plan.shards == n_host * n_data
+            back = zoo.zero3_full_view(st, plan, n_host=n_host)
+            assert tree_bitequal(view["params"], back["params"])
+            assert tree_bitequal(view["mom"], back["mom"])
+            assert float(back["scale"]) == 8.0
+            assert int(back["good_steps"]) == 5
+
+    def test_init_full_params_round_trip(self):
+        model = _tiny_model()
+        fused = FusedStepConfig(update=True, tail=True, zero=3)
+        params0, _, _ = model.init(jax.random.key(7), TINY_SHAPE)
+        st, plan = zoo.init_zero3_state(
+            model, jax.random.key(7), TINY_SHAPE, n_data=4, fused=fused,
+            bucket_bytes=2048, n_host=2,
+        )
+        assert tree_bitequal(params0, zoo.zero3_full_params(st, plan,
+                                                            n_host=2))
+
+
+class TestShardedCheckpoint:
+    def _trained_view(self, mesh8, rng, steps=2):
+        x, y = _tiny_batch(rng)
+        st, plan, _ = _run_zero3(mesh8, x, y, steps=steps)
+        return zoo.zero3_full_view(st, plan)
+
+    def test_save_reshard_restore_bit_exact(self, mesh8, rng, tmp_path):
+        from parallel_cnn_tpu.train import checkpoint
+
+        view8 = self._trained_view(mesh8, rng)
+        path = str(tmp_path / "ckpt_1.npz")
+        checkpoint.save_sharded(
+            path, view8, checkpoint.TrainState(epoch=1),
+            world_size=8, bucket_bytes=2048,
+        )
+        view, tstate, zmeta = checkpoint.restore_sharded(path, view8)
+        assert tstate.epoch == 1
+        assert zmeta == {"world_size": 8, "bucket_bytes": 2048}
+        assert tree_bitequal(view8, view)
+        # Re-shard the restored view for DIFFERENT world sizes and come
+        # back: shard<->full is reshape/transpose/slice only, so every
+        # lap is bit-exact.
+        for n_host, n_data in ((1, 4), (2, 4), (2, 2)):
+            st, plan = zoo.zero3_from_view(
+                view, n_data=n_data, bucket_bytes=2048, n_host=n_host
+            )
+            back = zoo.zero3_full_view(st, plan, n_host=n_host)
+            assert tree_bitequal(view8["params"], back["params"])
+            assert tree_bitequal(view8["mom"], back["mom"])
+
+    def test_plain_readers_reject_sharded_with_typed_error(
+        self, mesh8, rng, tmp_path
+    ):
+        from parallel_cnn_tpu.train import checkpoint
+
+        view8 = self._trained_view(mesh8, rng, steps=1)
+        path = str(tmp_path / "ckpt_1.npz")
+        checkpoint.save_sharded(path, view8, world_size=8,
+                                bucket_bytes=2048)
+        with pytest.raises(ValueError, match="use restore_sharded"):
+            checkpoint.restore(path, view8)
+        with pytest.raises(ValueError, match="use restore_sharded"):
+            checkpoint.load_params(path, view8["params"])
+
+    def test_restore_sharded_rejects_plain(self, tmp_path):
+        from parallel_cnn_tpu.train import checkpoint
+
+        path = str(tmp_path / "ckpt_1.npz")
+        tree = {"w": jnp.ones((4,), jnp.float32)}
+        checkpoint.save(path, tree)
+        with pytest.raises(ValueError, match="not a sharded checkpoint"):
+            checkpoint.restore_sharded(path, tree)
+
+
+# ---------------------------------------------------------------------------
 # Sentinel loss-scaling policy (resilience/sentinel.py:check_scaled)
 # ---------------------------------------------------------------------------
 
