@@ -14,6 +14,11 @@
 #             (benches/comm_multihost.py): weak-scaling rows + the
 #             hier-vs-psum parity gate. CPU-only and self-contained —
 #             runnable without the relay, so it can gate commits too.
+#   check     graftcheck with the cost/sharding families
+#             (`python -m parallel_cnn_tpu check --cost`): static comm
+#             bytes vs the closed-form tables, peak-HBM accounting, the
+#             DCN/HBM ratchet. CPU-only, gates commits like
+#             comm-multihost; the report grep is the contract line.
 #
 # All artifacts append/write under docs/ with the given tag (default: the
 # UTC date), so repeated runs accumulate evidence instead of overwriting.
@@ -36,6 +41,22 @@ if [ "$MODE" = "comm-multihost" ]; then
   RC=$?; echo "comm-multihost rc=$RC" >> "$LOG"
   # The gate line is the contract: both legs' hier-vs-psum parity <= 1e-5.
   grep -q 'COMM_MULTIHOST_GATE PASS' "$OUT" || RC=1
+  [ $RC -ne 0 ] && OVERALL=1
+  echo "=== playbook ${MODE} end rc=${OVERALL} $(date -u +%FT%TZ) ===" >> "$LOG"
+  exit $OVERALL
+fi
+
+if [ "$MODE" = "check" ]; then
+  echo "--- graftcheck --cost gate ---" >> "$LOG"
+  OUT="docs/check_cost_${TAG}.txt"
+  # 8 virtual devices so the zoo/hier traces (and hence the byte tables)
+  # match the documented 2-host emulated mesh exactly.
+  timeout 900 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -m parallel_cnn_tpu check --cost > "$OUT" 2>&1
+  RC=$?; echo "check --cost rc=$RC" >> "$LOG"
+  # The gate line is the contract: zero gating errors on a clean tree.
+  grep -q 'graftcheck: 0 gating error(s)' "$OUT" || RC=1
   [ $RC -ne 0 ] && OVERALL=1
   echo "=== playbook ${MODE} end rc=${OVERALL} $(date -u +%FT%TZ) ===" >> "$LOG"
   exit $OVERALL

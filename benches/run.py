@@ -1043,6 +1043,136 @@ def bench_serve(quick: bool) -> List[Row]:
     return rows
 
 
+def bench_cost(quick: bool) -> List[Row]:
+    """--suite cost: the static cost accountant next to measured CPU rows.
+
+    For every zoo entry point the graftcheck cost family traces
+    (analysis/cost_model.py), three static rows — jaxpr-counted ICI/DCN
+    bytes with the closed-form table value as the baseline column (the
+    `check --cost` gate asserts these EQUAL; speedup 1.0 means the model
+    is exact), and the peak-HBM accounting — then a timed img/s row of
+    the SAME step configuration with the analytic roofline as baseline,
+    so the model and the measurement are diffable in one place.  On the
+    CPU harness the roofline is aspirational (shared-memory "ICI", no
+    MXU); the static byte rows are platform-independent."""
+    from parallel_cnn_tpu.analysis import cost_model, jaxpr_rules
+    from parallel_cnn_tpu.config import CommConfig, FusedStepConfig, MeshConfig
+    from parallel_cnn_tpu.data import synthetic
+    from parallel_cnn_tpu.nn import cifar
+    from parallel_cnn_tpu.train import zoo
+    from parallel_cnn_tpu.parallel import mesh as mesh_lib
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        return []
+
+    rows: List[Row] = []
+    costs = {}
+    for name, closed, spec in jaxpr_rules.trace_entry_points(
+        fast=False, with_specs=True
+    ):
+        if spec is None:
+            continue
+        c = cost_model.entry_costs(name, closed, spec)
+        costs[name] = c
+        short = name.replace("zoo.", "").replace("_step", "")
+        rows.append(
+            Row(f"cost_{short}.ici", float(c["bytes_ici"]), "bytes/step/dev",
+                baseline=float(c["expected_bytes_ici"]),
+                baseline_src="closed-form table, docs/collectives.md").finish()
+        )
+        if c["bytes_dcn"] or c["expected_bytes_dcn"]:
+            rows.append(
+                Row(f"cost_{short}.dcn", float(c["bytes_dcn"]),
+                    "bytes/step/dev",
+                    baseline=float(c["expected_bytes_dcn"]),
+                    baseline_src="closed-form table, "
+                                 "docs/collectives.md").finish()
+            )
+        rows.append(
+            Row(f"cost_{short}.peak_hbm", float(c["peak_hbm"]), "bytes/dev",
+                baseline=None,
+                baseline_src=(
+                    f"resident+activations+grad shards; transient "
+                    f"gather {c['transient_gather_bytes']} B"
+                )).finish()
+        )
+
+    # --- timed legs: the same configurations the specs describe ---
+    batch = 2 * n_dev
+    imgs, labels = synthetic.make_image_dataset(batch, seed=3)
+    model = cifar.cifar_cnn()
+    ring_bf16 = CommConfig(impl="ring", wire_dtype="bfloat16")
+    repeats = 5 if quick else 15
+
+    def timed_row(entry, mesh, make_state, step):
+        x, y = mesh_lib.shard_batch(
+            mesh, (jnp.asarray(imgs), jnp.asarray(labels))
+        )
+        def thunk(carry, step=step, x=x, y=y):
+            s = carry[0] if carry is not None else make_state()
+            return step(s, x, y)
+
+        ips, ips_range, n_s = _sampled_ips(
+            thunk, repeats=repeats, images_per_call=batch
+        )
+        c = costs[entry]
+        short = entry.replace("zoo.", "").replace("_step", "")
+        rows.append(
+            Row(f"cost_{short}.img_s", ips, "images/sec",
+                baseline=c["roofline_img_s"],
+                baseline_src="analytic roofline (cost_report.json)",
+                value_range=ips_range, value_samples=n_s).finish()
+        )
+
+    mesh = mesh_lib.make_mesh(MeshConfig(data=n_dev, model=1))
+    opt = zoo.make_optimizer(0.01, momentum=0.9)
+    timed_row(
+        "zoo.comm_step.ring_bf16", mesh,
+        lambda: zoo.init_state(model, jax.random.key(1),
+                               cifar.IN_SHAPE, opt),
+        zoo.make_train_step(model, opt, accum_steps=2, mesh=mesh,
+                            comm=ring_bf16),
+    )
+    fused = FusedStepConfig(update=True, tail=True, act_dtype="bfloat16")
+    fst, n_buckets = zoo.init_fused_state(
+        model, jax.random.key(1), cifar.IN_SHAPE,
+        n_data=n_dev, fused=fused, bucket_bytes=ring_bf16.bucket_bytes,
+    )
+    del fst
+    timed_row(
+        "zoo.fused_step.ring_bf16", mesh,
+        lambda: zoo.init_fused_state(
+            model, jax.random.key(1), cifar.IN_SHAPE, n_data=n_dev,
+            fused=fused, bucket_bytes=ring_bf16.bucket_bytes,
+        )[0],
+        zoo.make_fused_train_step(
+            model, lr=0.01, momentum=0.9, accum_steps=2, mesh=mesh,
+            augment=None, comm=ring_bf16, fused=fused,
+            n_buckets=n_buckets,
+        ),
+    )
+    z3 = FusedStepConfig(update=True, tail=True, act_dtype="bfloat16",
+                         zero=3)
+    zst, zplan = zoo.init_zero3_state(
+        model, jax.random.key(1), cifar.IN_SHAPE,
+        n_data=n_dev, fused=z3, bucket_bytes=ring_bf16.bucket_bytes,
+    )
+    del zst
+    timed_row(
+        "zoo.zero3_step.ring_bf16", mesh,
+        lambda: zoo.init_zero3_state(
+            model, jax.random.key(1), cifar.IN_SHAPE, n_data=n_dev,
+            fused=z3, bucket_bytes=ring_bf16.bucket_bytes,
+        )[0],
+        zoo.make_zero3_train_step(
+            model, lr=0.01, momentum=0.9, accum_steps=2, mesh=mesh,
+            augment=None, comm=ring_bf16, fused=z3, plan=zplan,
+        ),
+    )
+    return rows
+
+
 def render_md(rows: List[Row]) -> str:
     lines = [
         "| benchmark | value | unit | reference baseline | speedup | samples |",
@@ -1074,7 +1204,7 @@ def main(argv=None) -> int:
         "--suite",
         default="all",
         choices=["all", "lenet", "phases", "dp", "zoo", "parity", "ops",
-                 "comm", "northstar", "serve", "fused"],
+                 "comm", "northstar", "serve", "fused", "cost"],
     )
     args = ap.parse_args(argv)
 
@@ -1096,6 +1226,7 @@ def main(argv=None) -> int:
         "northstar": bench_northstar,
         "serve": bench_serve,
         "fused": bench_fused,
+        "cost": bench_cost,
     }
     picked = suites.values() if args.suite == "all" else [suites[args.suite]]
 

@@ -70,6 +70,11 @@ def run_check(
     update_baseline: bool = False,
     verbose: bool = False,
     race_seeds: Tuple[int, ...] = (0, 1),
+    cost: bool = False,
+    cost_baseline_path: Optional[Path] = None,
+    update_cost_baseline: bool = False,
+    cost_report_path: Optional[Path] = None,
+    cost_seeded: Optional[str] = None,
 ) -> Tuple[int, str, List[Diagnostic]]:
     """Run graftcheck; returns (exit_code, report, diagnostics).
 
@@ -79,6 +84,14 @@ def run_check(
     seeded-violation dryrun leg and the rule fixtures use.
     ``fast`` keeps all families but trims the expensive configurations
     (zoo traces, deep model budgets, single race seed).
+    ``cost`` adds the sharding-propagation and static-cost families
+    (analysis/sharding_prop.py, analysis/cost_model.py).  They trace the
+    FULL zoo entry set even under ``fast`` — the byte models are about
+    the zoo collectives, there is no trimmed configuration that still
+    means anything — and share one trace with each other.
+    ``cost_seeded`` appends a really-traced mutant entry
+    (cost_model.build_seeded_entry) so the dryrun can prove the gate
+    trips; the mutant also runs under the jaxpr-rule families.
     """
     from parallel_cnn_tpu.analysis import ast_rules, concurrency
 
@@ -129,7 +142,30 @@ def run_check(
 
         from parallel_cnn_tpu.analysis import jaxpr_rules, pallas_budget
 
-        diags.extend(jaxpr_rules.run_jaxpr_rules(fast=fast))
+        if cost:
+            from parallel_cnn_tpu.analysis import cost_model, sharding_prop
+
+            # One full trace shared by every jaxpr-consuming family: the
+            # cost/sharding analyzers need the zoo entries regardless of
+            # --fast (the byte models ARE the zoo collectives).
+            entries = jaxpr_rules.trace_entry_points(
+                fast=False, with_specs=True
+            )
+            if cost_seeded:
+                entries = entries + [
+                    cost_model.build_seeded_entry(cost_seeded)
+                ]
+            for name, closed, _spec in entries:
+                diags.extend(jaxpr_rules.analyze_closed_jaxpr(name, closed))
+            diags.extend(sharding_prop.run_sharding_rules(entries))
+            diags.extend(cost_model.run_cost_rules(
+                entries,
+                baseline_path=cost_baseline_path,
+                update_baseline=update_cost_baseline,
+                report_path=cost_report_path,
+            ))
+        else:
+            diags.extend(jaxpr_rules.run_jaxpr_rules(fast=fast))
         diags.extend(pallas_budget.run_pallas_budget(fast=fast))
         seeds = race_seeds[:1] if fast else race_seeds
         diags.extend(concurrency.run_race_checks(seeds=seeds))
@@ -171,6 +207,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help=f"ratchet baseline file (default {DEFAULT_BASELINE})")
     ap.add_argument("--update-baseline", action="store_true",
                     help="accept current unwaived errors into the baseline")
+    ap.add_argument("--cost", action="store_true",
+                    help="add the sharding-propagation + static cost "
+                         "families (comm bytes vs closed form, peak HBM, "
+                         "DCN/HBM ratchet); also via PCNN_CHECK_COST=1")
+    ap.add_argument("--cost-baseline", type=Path, default=None,
+                    metavar="PATH",
+                    help="cost ratchet baseline file (default "
+                         "analysis/cost_baseline.json)")
+    ap.add_argument("--update-cost-baseline", action="store_true",
+                    help="rewrite the cost baseline from the current tree")
+    ap.add_argument("--cost-report", type=Path, default=None, metavar="PATH",
+                    help="cost report output (default "
+                         "analysis/cost_report.json)")
+    ap.add_argument("--cost-seeded", default=None, metavar="NAME",
+                    help="append a seeded mutant entry (bf16-master-gather) "
+                         "— the anti-vacuity leg of the dryrun")
     ap.add_argument("--json", type=Path, default=None, metavar="PATH",
                     help="also write diagnostics as JSON")
     ap.add_argument("--verbose", "-v", action="store_true",
@@ -183,6 +235,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         baseline_path=args.baseline,
         update_baseline=args.update_baseline,
         verbose=args.verbose,
+        cost=args.cost or bool(args.cost_seeded) or args.update_cost_baseline,
+        cost_baseline_path=args.cost_baseline,
+        update_cost_baseline=args.update_cost_baseline,
+        cost_report_path=args.cost_report,
+        cost_seeded=args.cost_seeded,
     )
     if args.json:
         args.json.write_text(

@@ -38,6 +38,7 @@ sub-jaxprs) checking:
 
 from __future__ import annotations
 
+import dataclasses
 from typing import (
     Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple,
 )
@@ -392,11 +393,75 @@ def _weak_types(file: str, closed) -> List[Diagnostic]:
 # Entry-point registry
 # ---------------------------------------------------------------------------
 
-def trace_entry_points(fast: bool = False) -> List[Tuple[str, object]]:
+@dataclasses.dataclass(frozen=True)
+class EntrySpec:
+    """Static cost description of one traced zoo entry point.
+
+    Everything the closed-form byte/HBM models (analysis/cost_model.py)
+    need, captured at trace time from the same state/plan/config objects
+    the step was built from — no re-derivation from the jaxpr, so the
+    measured walk and the analytic model stay independent.
+    """
+
+    kind: str            # ring_overlap | hier_overlap | zero2_ring |
+                         # zero3_ring | zero3_hier (docs/collectives.md)
+    n_dev: int           # device-axis ring size D (intra-host / ICI)
+    n_host: int          # host-axis ring size H (1 on flat meshes / DCN)
+    accum: int           # K gradient-accumulation microbatches per step
+    wire_itemsize: int   # gradient wire dtype bytes (bfloat16 = 2)
+    bucket_elems: Tuple[int, ...]  # padded element count per bucket (E_b)
+    resident_bytes: int  # per-device resident state bytes under the
+                         # DECLARED sharding (ZeRO level applied)
+    act_bytes: int       # activation high-water mark per device microbatch
+    images_per_step: int  # global batch consumed by one step
+    n_state_leaves: int  # leaves of the ZooState pytree (sharding_prop)
+    transient_gather_bytes: int = 0  # zero3 head-gather peak (full f32
+                                     # params, freed before backward)
+
+
+def _tree_bytes(tree) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        arr = jnp.asarray(leaf)
+        total += int(arr.size) * arr.dtype.itemsize
+    return total
+
+
+def _activation_hwm(model, params, mstate, microbatch: int,
+                    in_shape: Tuple[int, ...], act_itemsize: int) -> int:
+    """Peak simultaneous (input + output) activation bytes of any single
+    layer, per device microbatch, via per-layer ``jax.eval_shape`` over
+    ``Sequential.layers`` — no layer runs.  ``act_itemsize`` scales the
+    footprint to the step's activation dtype (bf16 entries halve it)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    x = jax.ShapeDtypeStruct((microbatch, *in_shape), jnp.float32)
+    peak = 0
+    for layer, p, s in zip(model.layers, params, mstate):
+        y, _ = jax.eval_shape(
+            lambda p_, s_, x_: layer.apply(p_, s_, x_, True), p, s, x
+        )
+        live = int(np.prod(x.shape) + np.prod(y.shape)) * act_itemsize
+        peak = max(peak, live)
+        x = jax.ShapeDtypeStruct(y.shape, y.dtype)
+    return peak
+
+
+def trace_entry_points(
+    fast: bool = False, with_specs: bool = False
+) -> List[Tuple]:
     """Trace the real entry points abstractly; returns (name, ClosedJaxpr).
 
     ``fast`` skips the zoo steps (the most expensive traces).  Zoo traces
     also require a ≥2-device mesh; on a single device they are skipped.
+    ``with_specs`` returns (name, ClosedJaxpr, EntrySpec-or-None) triples
+    instead — the cost analyzers consume the spec, plain entries carry
+    None.
     """
     import jax
     import jax.numpy as jnp
@@ -405,7 +470,12 @@ def trace_entry_points(fast: bool = False) -> List[Tuple[str, object]]:
     from parallel_cnn_tpu.models import lenet_ref
     from parallel_cnn_tpu.train import step
 
-    out: List[Tuple[str, object]] = []
+    out: List[Tuple] = []
+
+    def _finish(entries):
+        if with_specs:
+            return entries
+        return [(n, c) for n, c, _ in entries]
 
     lp = lenet_ref.init(jax.random.key(0))
     lx = jnp.zeros((8, 28, 28), jnp.float32)
@@ -415,12 +485,14 @@ def trace_entry_points(fast: bool = False) -> List[Tuple[str, object]]:
         jax.make_jaxpr(lambda p, x, y: step.batched_step(p, x, y, 0.05))(
             lp, lx, ly
         ),
+        None,
     ))
     out.append((
         "train.fused_batched_step",
         jax.make_jaxpr(
             lambda p, x, y: step.fused_batched_step(p, x, y, 0.05)
         )(lp, lx, ly),
+        None,
     ))
 
     from parallel_cnn_tpu.serve import registry as serve_registry
@@ -431,17 +503,19 @@ def trace_entry_points(fast: bool = False) -> List[Tuple[str, object]]:
     out.append((
         "serve.engine_forward",
         jax.make_jaxpr(lambda p, st, v: sh.forward(p, st, v))(sp, sst, sx),
+        None,
     ))
 
     if fast:
-        return out
+        return _finish(out)
 
     n_dev = len(jax.devices())
     if n_dev < 2:
-        return out
+        return _finish(out)
 
     from parallel_cnn_tpu.config import CommConfig, FusedStepConfig, MeshConfig
     from parallel_cnn_tpu.nn import cifar
+    from parallel_cnn_tpu.parallel import collectives
     from parallel_cnn_tpu.parallel import mesh as mesh_lib
     from parallel_cnn_tpu.train import zoo
 
@@ -460,9 +534,24 @@ def trace_entry_points(fast: bool = False) -> List[Tuple[str, object]]:
         comm_step = zoo.make_train_step(
             model, opt, accum_steps=2, mesh=mesh, comm=ring_bf16
         )
+        # The step plans its buckets from the grad tree, which mirrors the
+        # param tree leaf-for-leaf (same shapes/dtypes) — same plan here.
+        plan = collectives.plan_buckets(
+            st.params, ring_bf16.bucket_bytes, shards=n_data
+        )
         out.append((
             "zoo.comm_step.ring_bf16",
             jax.make_jaxpr(comm_step)(st, zx, zy),
+            EntrySpec(
+                kind="ring_overlap", n_dev=n_data, n_host=1, accum=2,
+                wire_itemsize=2, bucket_elems=tuple(plan.bucket_sizes),
+                resident_bytes=_tree_bytes(st),
+                act_bytes=_activation_hwm(
+                    model, st.params, st.model_state, 1, cifar.IN_SHAPE, 4
+                ),
+                images_per_step=2 * n_data,
+                n_state_leaves=len(jax.tree_util.tree_leaves(st)),
+            ),
         ))
 
         # Sharpest wire check: activations AND gradient wire in bf16 —
@@ -476,9 +565,21 @@ def trace_entry_points(fast: bool = False) -> List[Tuple[str, object]]:
             model, lr=0.01, momentum=0.9, accum_steps=2, mesh=mesh,
             augment=None, comm=ring_bf16, fused=fused, n_buckets=n_buckets,
         )
+        # ZeRO-2: params/model_state replicated, momentum a 1/n shard.
+        fmom = _tree_bytes(fst.opt_state.mom)
         out.append((
             "zoo.fused_step.ring_bf16",
             jax.make_jaxpr(fused_step)(fst, zx, zy),
+            EntrySpec(
+                kind="zero2_ring", n_dev=n_data, n_host=1, accum=2,
+                wire_itemsize=2, bucket_elems=tuple(plan.bucket_sizes),
+                resident_bytes=_tree_bytes(fst) - fmom + fmom // n_data,
+                act_bytes=_activation_hwm(
+                    model, fst.params, fst.model_state, 1, cifar.IN_SHAPE, 2
+                ),
+                images_per_step=2 * n_data,
+                n_state_leaves=len(jax.tree_util.tree_leaves(fst)),
+            ),
         ))
 
         # ZeRO-3 on the flat ring, sharpest setting again: bf16 gradient
@@ -495,9 +596,26 @@ def trace_entry_points(fast: bool = False) -> List[Tuple[str, object]]:
             model, lr=0.01, momentum=0.9, accum_steps=2, mesh=mesh,
             augment=None, comm=ring_bf16, fused=z3, plan=zplan,
         )
+        # ZeRO-3: params AND momentum resident as 1/n bucket-row shards;
+        # the head gather's full f32 params are transient, not resident.
+        zsharded = _tree_bytes(zst.params) + _tree_bytes(zst.opt_state.mom)
         out.append((
             "zoo.zero3_step.ring_bf16",
             jax.make_jaxpr(zero3_step)(zst, zx, zy),
+            EntrySpec(
+                kind="zero3_ring", n_dev=n_data, n_host=1, accum=2,
+                wire_itemsize=2, bucket_elems=tuple(zplan.bucket_sizes),
+                resident_bytes=(
+                    _tree_bytes(zst) - zsharded + zsharded // n_data
+                ),
+                act_bytes=_activation_hwm(
+                    model, zoo.zero3_full_params(zst, zplan),
+                    zst.model_state, 1, cifar.IN_SHAPE, 2
+                ),
+                images_per_step=2 * n_data,
+                n_state_leaves=len(jax.tree_util.tree_leaves(zst)),
+                transient_gather_bytes=sum(zplan.bucket_sizes) * 4,
+            ),
         ))
 
     # Hierarchical two-level rings need a (host, device) mesh; 2 emulated
@@ -517,9 +635,24 @@ def trace_entry_points(fast: bool = False) -> List[Tuple[str, object]]:
                 model, opt, accum_steps=2, mesh=hmesh, comm=hier_bf16
             )
             hst = zoo.init_state(model, jax.random.key(1), cifar.IN_SHAPE, opt)
+            hplan = collectives.plan_buckets(
+                hst.params, hier_bf16.bucket_bytes, shards=n_dev
+            )
             out.append((
                 "zoo.comm_step.hier_bf16",
                 jax.make_jaxpr(hier_step)(hst, hx, hy),
+                EntrySpec(
+                    kind="hier_overlap", n_dev=n_hdev, n_host=n_host,
+                    accum=2, wire_itemsize=2,
+                    bucket_elems=tuple(hplan.bucket_sizes),
+                    resident_bytes=_tree_bytes(hst),
+                    act_bytes=_activation_hwm(
+                        model, hst.params, hst.model_state, 1,
+                        cifar.IN_SHAPE, 4
+                    ),
+                    images_per_step=2 * n_dev,
+                    n_state_leaves=len(jax.tree_util.tree_leaves(hst)),
+                ),
             ))
 
             z3h = FusedStepConfig(
@@ -534,11 +667,29 @@ def trace_entry_points(fast: bool = False) -> List[Tuple[str, object]]:
                 model, lr=0.01, momentum=0.9, accum_steps=2, mesh=hmesh,
                 augment=None, comm=hier_bf16, fused=z3h, plan=zplanh,
             )
+            zhsharded = (
+                _tree_bytes(zsth.params) + _tree_bytes(zsth.opt_state.mom)
+            )
             out.append((
                 "zoo.zero3_step.hier_bf16",
                 jax.make_jaxpr(zero3_hier)(zsth, hx, hy),
+                EntrySpec(
+                    kind="zero3_hier", n_dev=n_hdev, n_host=n_host,
+                    accum=2, wire_itemsize=2,
+                    bucket_elems=tuple(zplanh.bucket_sizes),
+                    resident_bytes=(
+                        _tree_bytes(zsth) - zhsharded + zhsharded // n_dev
+                    ),
+                    act_bytes=_activation_hwm(
+                        model, zoo.zero3_full_params(zsth, zplanh, n_host=n_host),
+                        zsth.model_state, 1, cifar.IN_SHAPE, 2
+                    ),
+                    images_per_step=2 * n_dev,
+                    n_state_leaves=len(jax.tree_util.tree_leaves(zsth)),
+                    transient_gather_bytes=sum(zplanh.bucket_sizes) * 4,
+                ),
             ))
-    return out
+    return _finish(out)
 
 
 def run_jaxpr_rules(fast: bool = False) -> List[Diagnostic]:

@@ -458,6 +458,13 @@ def _run_check(argv: List[str]) -> int:
     except RuntimeError:
         pass  # backend already initialized (embedded call): analyze as-is
 
+    # PCNN_CHECK_COST=1 turns on the cost/sharding families for every
+    # check invocation — the CI spelling of `check --cost` (docs/api.md).
+    # graftcheck: disable=env-outside-config -- check-dispatch knob: must act before checker argparse, config.py is not imported on this path
+    if os.environ.get("PCNN_CHECK_COST", "").lower() in ("1", "true") \
+            and "--cost" not in argv:
+        argv = ["--cost"] + argv
+
     from parallel_cnn_tpu.analysis import checker
 
     return checker.main(argv)

@@ -20,7 +20,13 @@ import pytest
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from parallel_cnn_tpu.analysis import ast_rules, concurrency, jaxpr_rules
+from parallel_cnn_tpu.analysis import (
+    ast_rules,
+    concurrency,
+    cost_model,
+    jaxpr_rules,
+    sharding_prop,
+)
 from parallel_cnn_tpu.analysis import pallas_budget as budget_mod
 from parallel_cnn_tpu.analysis.checker import run_check
 from parallel_cnn_tpu.analysis.diagnostics import (
@@ -713,3 +719,154 @@ def test_shipped_docs_pass_parity_and_xref():
         docs, checker._existing(checker.PARSER_FILES),
         checker.REPO_ROOT / "benches" / "run.py",
     ) == []
+
+
+# ---------------------------------------------------------------------------
+# sharding-propagation + cost families (check --cost)
+# ---------------------------------------------------------------------------
+
+def _spec(**kw):
+    base = dict(
+        kind="ring_overlap", n_dev=4, n_host=1, accum=2, wire_itemsize=2,
+        bucket_elems=(400,), resident_bytes=0, act_bytes=0,
+        images_per_step=8, n_state_leaves=1,
+    )
+    base.update(kw)
+    return jaxpr_rules.EntrySpec(**base)
+
+
+def test_implicit_reshard_trips_on_seeded_master_gather(host_devices):
+    name, closed, spec = cost_model.build_seeded_entry("bf16-master-gather")
+    hits = _by_rule(
+        sharding_prop.analyze_entry_sharding(name, closed, spec),
+        "implicit-reshard",
+    )
+    assert hits and "replicated" in hits[0].message
+
+
+def test_implicit_reshard_clean_on_sharded_roundtrip(mesh4):
+    closed = _shmap_jaxpr(
+        mesh4, lambda v: v * 2.0, jnp.zeros((8, 4), jnp.float32)
+    )
+    diags = sharding_prop.analyze_entry_sharding("fixture", closed, _spec())
+    assert not _by_rule(diags, "implicit-reshard")
+
+
+def test_sharding_contradiction_trips_on_double_psum(mesh4):
+    def double(v):
+        return lax.psum(lax.psum(v, "data"), "data")
+
+    closed = _shmap_jaxpr(
+        mesh4, double, jnp.zeros((8, 4), jnp.float32), out_specs=P()
+    )
+    hits = _by_rule(
+        sharding_prop.analyze_entry_sharding("fixture", closed, None),
+        "sharding-contradiction",
+    )
+    assert hits and "replicated over that axis" in hits[0].message
+
+
+def test_sharding_contradiction_clean_on_single_psum(mesh4):
+    closed = _shmap_jaxpr(
+        mesh4, lambda v: lax.psum(v, "data"),
+        jnp.zeros((8, 4), jnp.float32), out_specs=P()
+    )
+    assert not _by_rule(
+        sharding_prop.analyze_entry_sharding("fixture", closed, None),
+        "sharding-contradiction",
+    )
+
+
+def _ring_overlap_fixture(mesh):
+    """A schedule whose counted bytes EQUAL the ring_overlap closed form:
+    K+1 = 3 bf16 all-gathers of a 100-element shard on the 4-device ring
+    = 3 * (4-1) * 100 * 2 bytes, exactly (K=2, E=400, w=2)."""
+    from parallel_cnn_tpu.parallel import collectives
+
+    def body(shard):
+        for _ in range(3):
+            full = collectives.ring_all_gather(shard, "data", 4, "bfloat16")
+            shard = full[: shard.shape[0]]
+        return shard
+
+    return _shmap_jaxpr(mesh, body, jnp.zeros((400,), jnp.float32))
+
+
+def test_cost_model_clean_on_matching_schedule(mesh4, tmp_path):
+    closed = _ring_overlap_fixture(mesh4)
+    diags = cost_model.run_cost_rules(
+        [("fixture", closed, _spec(resident_bytes=1000))],
+        baseline_path=tmp_path / "b.json",
+        report_path=tmp_path / "r.json",
+    )
+    assert not _by_rule(diags, "cost-model-mismatch")
+
+
+def test_cost_model_mismatch_trips_on_seeded_gather(host_devices, tmp_path):
+    entry = cost_model.build_seeded_entry("bf16-master-gather")
+    diags = cost_model.run_cost_rules(
+        [entry],
+        baseline_path=tmp_path / "b.json",
+        report_path=tmp_path / "r.json",
+    )
+    hits = _by_rule(diags, "cost-model-mismatch")
+    assert hits and "closed-form" in hits[0].message
+
+
+def test_cost_ratchet_trips_on_growth_past_baseline(mesh4, tmp_path):
+    closed = _ring_overlap_fixture(mesh4)
+    spec = _spec(resident_bytes=1000)   # peak_hbm = 1000 + 100*4 = 1400
+    cost_model.save_cost_baseline(
+        tmp_path / "b.json",
+        {"fixture": {"bytes_dcn": 0, "peak_hbm": 1399}},
+    )
+    diags = cost_model.run_cost_rules(
+        [("fixture", closed, spec)],
+        baseline_path=tmp_path / "b.json",
+        report_path=tmp_path / "r.json",
+    )
+    hits = _by_rule(diags, "cost-ratchet")
+    assert hits and "--update-cost-baseline" in hits[0].message
+
+
+def test_cost_ratchet_clean_at_baseline_and_on_missing_entry(mesh4, tmp_path):
+    closed = _ring_overlap_fixture(mesh4)
+    spec = _spec(resident_bytes=1000)
+    # Exactly at the recorded values: no diagnostic (ratchet is >, not >=).
+    cost_model.save_cost_baseline(
+        tmp_path / "b.json",
+        {"fixture": {"bytes_dcn": 0, "peak_hbm": 1400}},
+    )
+    diags = cost_model.run_cost_rules(
+        [("fixture", closed, spec)],
+        baseline_path=tmp_path / "b.json",
+        report_path=tmp_path / "r.json",
+    )
+    assert not _by_rule(diags, "cost-ratchet")
+    # Entries absent from the baseline pass (they ratchet from their
+    # first recorded run, they do not gate retroactively).
+    cost_model.save_cost_baseline(tmp_path / "b.json", {})
+    diags = cost_model.run_cost_rules(
+        [("fixture", closed, spec)],
+        baseline_path=tmp_path / "b.json",
+        report_path=tmp_path / "r.json",
+    )
+    assert not _by_rule(diags, "cost-ratchet")
+
+
+def test_expected_bytes_match_documented_anchors():
+    """Pin the docs/collectives.md 'Exact per-impl byte tables' anchor
+    numbers (single E=308400 bucket, K=2, bf16 wire, 8 devices)."""
+    e = (308400,)
+    assert cost_model.expected_collective_bytes(
+        _spec(kind="ring_overlap", n_dev=8, bucket_elems=e)
+    ) == (1619100, 0)
+    assert cost_model.expected_collective_bytes(
+        _spec(kind="hier_overlap", n_dev=4, n_host=2, bucket_elems=e)
+    ) == (1387800, 231300)
+    assert cost_model.expected_collective_bytes(
+        _spec(kind="zero3_ring", n_dev=8, bucket_elems=e)
+    ) == (2158800, 0)
+    assert cost_model.expected_collective_bytes(
+        _spec(kind="zero3_hier", n_dev=4, n_host=2, bucket_elems=e)
+    ) == (1850400, 308400)
