@@ -19,6 +19,10 @@
 #             bytes vs the closed-form tables, peak-HBM accounting, the
 #             DCN/HBM ratchet. CPU-only, gates commits like
 #             comm-multihost; the report grep is the contract line.
+#   obs       observability overhead gate (benches/run.py --suite obs):
+#             traced-vs-untraced step throughput pairs; tracing must hold
+#             >= 0.95x untraced. CPU-only and self-contained — gates
+#             commits like comm-multihost; OBS_GATE is the contract line.
 #
 # All artifacts append/write under docs/ with the given tag (default: the
 # UTC date), so repeated runs accumulate evidence instead of overwriting.
@@ -57,6 +61,19 @@ if [ "$MODE" = "check" ]; then
   RC=$?; echo "check --cost rc=$RC" >> "$LOG"
   # The gate line is the contract: zero gating errors on a clean tree.
   grep -q 'graftcheck: 0 gating error(s)' "$OUT" || RC=1
+  [ $RC -ne 0 ] && OVERALL=1
+  echo "=== playbook ${MODE} end rc=${OVERALL} $(date -u +%FT%TZ) ===" >> "$LOG"
+  exit $OVERALL
+fi
+
+if [ "$MODE" = "obs" ]; then
+  echo "--- obs overhead gate ---" >> "$LOG"
+  OUT="docs/obs_${TAG}.txt"
+  timeout 900 env JAX_PLATFORMS=cpu \
+    python benches/run.py --quick --suite obs > "$OUT" 2>&1
+  RC=$?; echo "obs rc=$RC" >> "$LOG"
+  # The gate line is the contract: traced throughput >= 0.95x untraced.
+  grep -q 'OBS_GATE PASS' "$OUT" || RC=1
   [ $RC -ne 0 ] && OVERALL=1
   echo "=== playbook ${MODE} end rc=${OVERALL} $(date -u +%FT%TZ) ===" >> "$LOG"
   exit $OVERALL

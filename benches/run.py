@@ -1173,6 +1173,123 @@ def bench_cost(quick: bool) -> List[Row]:
     return rows
 
 
+def bench_obs(quick: bool) -> List[Row]:
+    """Observability overhead gate (obs/): the SAME training step timed
+    under the default no-op bundle vs a LIVE Tracer + event journal —
+    spans around every dispatch, one journal record per step, exactly the
+    hot-path hooks trainer/zoo wire when --trace is on.
+
+    Rows come in traced/untraced pairs for the lenet batched step and the
+    zoo CIFAR step; each traced row's baseline is its untraced twin, so
+    the speedup column IS the overhead ratio. The gate: traced must hold
+    >= 0.95x the untraced img/s (host-side spans are microseconds against
+    multi-ms steps; losing 5% means someone put work on the step path).
+    A violation appends an error-unit row (nonzero exit) and the
+    OBS_GATE line flips to FAIL — the playbook greps for it."""
+    import tempfile
+
+    from parallel_cnn_tpu import obs as obs_lib
+    from parallel_cnn_tpu.config import ObsConfig
+    from parallel_cnn_tpu.models import lenet_ref
+    from parallel_cnn_tpu.nn import cifar
+    from parallel_cnn_tpu.train import step as step_lib, zoo
+
+    obs_dir = tempfile.mkdtemp(prefix="pcnn_bench_obs_")
+    rng = np.random.default_rng(0)
+    repeats = 3 if quick else 6
+
+    # -- workload 1: lenet batched step ---------------------------------
+    lbatch = 1024
+    lx = jnp.asarray(rng.uniform(0, 1, (lbatch, 28, 28)).astype(np.float32))
+    ly = jnp.asarray(rng.integers(0, 10, (lbatch,)).astype(np.int32))
+    lstep = step_lib.batched_step_fn("reference")
+
+    def lenet_thunk(carry, bundle):
+        # Fresh init per sample: the step donates its params buffers, so
+        # a donated pytree can't seed the next _sync_time sample.
+        p = carry[0] if carry is not None else lenet_ref.init(
+            jax.random.key(0)
+        )
+        with bundle.span("bench.dispatch", cat="bench"):
+            out = lstep(p, lx, ly, 0.1)
+        if bundle.enabled:
+            bundle.event("bench_step")
+        return out
+
+    # -- workload 2: zoo CIFAR CNN step ---------------------------------
+    zbatch = 256
+    zx = jnp.asarray(
+        rng.uniform(0, 1, (zbatch, *cifar.IN_SHAPE)).astype(np.float32)
+    )
+    zy = jnp.asarray(rng.integers(0, 10, (zbatch,)).astype(np.int32))
+    zopt = zoo.make_optimizer(0.1)
+    zmodel = cifar.cifar_cnn()
+    zstep = zoo.make_train_step(zmodel, zopt)
+
+    def zoo_thunk(carry, bundle):
+        st = carry[0] if carry is not None else zoo.init_state(
+            zmodel, jax.random.key(1), cifar.IN_SHAPE, zopt
+        )
+        with bundle.span("bench.dispatch", cat="bench"):
+            out = zstep(st, zx, zy)
+        if bundle.enabled:
+            bundle.event("bench_step")
+        return out
+
+    rows: List[Row] = []
+    gate_ok = True
+    for name, thunk, per_call in (
+        ("lenet_step", lenet_thunk, lbatch),
+        ("zoo_step", zoo_thunk, zbatch),
+    ):
+        bundles = {
+            "untraced": obs_lib.NOOP,
+            "traced": obs_lib.from_config(
+                ObsConfig(trace=True, dir=obs_dir), run=f"bench_{name}"
+            ),
+        }
+        # Interleaved sampling: alternate modes within each sample round
+        # so slow host drift (thermal, co-tenant load) hits both sides
+        # equally instead of biasing whichever mode ran second.
+        samples = {m: [] for m in bundles}
+        for _ in range(_n_samples()):
+            for mode, bundle in bundles.items():
+                sec = _sync_time(
+                    lambda c, b=bundle, t=thunk: t(c, b), repeats
+                )
+                samples[mode].append(round(per_call / sec, 1))
+        bundles["traced"].finish()
+        ips_by_mode = {m: _median(v) for m, v in samples.items()}
+        for mode in ("untraced", "traced"):
+            vals = samples[mode]
+            rows.append(
+                Row(f"obs_{name}_{mode}", ips_by_mode[mode], "images/sec",
+                    baseline=(ips_by_mode["untraced"]
+                              if mode == "traced" else None),
+                    baseline_src=("vs untraced twin (gate >= 0.95x)"
+                                  if mode == "traced" else "no-op bundle"),
+                    value_range=[min(vals), max(vals)],
+                    value_samples=len(vals)).finish()
+            )
+        ratio = ips_by_mode["traced"] / ips_by_mode["untraced"]
+        if ratio < 0.95:
+            gate_ok = False
+            rows.append(
+                Row(f"error_obs_overhead_{name}", -1.0, "error",
+                    baseline_src=(
+                        f"traced {ips_by_mode['traced']} img/s is "
+                        f"{ratio:.3f}x untraced "
+                        f"{ips_by_mode['untraced']} (< 0.95x gate)"
+                    ))
+            )
+    print(
+        "OBS_GATE PASS" if gate_ok else
+        "OBS_GATE FAIL: tracing overhead exceeded the 5% budget",
+        flush=True,
+    )
+    return rows
+
+
 def render_md(rows: List[Row]) -> str:
     lines = [
         "| benchmark | value | unit | reference baseline | speedup | samples |",
@@ -1204,7 +1321,7 @@ def main(argv=None) -> int:
         "--suite",
         default="all",
         choices=["all", "lenet", "phases", "dp", "zoo", "parity", "ops",
-                 "comm", "northstar", "serve", "fused", "cost"],
+                 "comm", "northstar", "serve", "fused", "cost", "obs"],
     )
     args = ap.parse_args(argv)
 
@@ -1227,6 +1344,7 @@ def main(argv=None) -> int:
         "serve": bench_serve,
         "fused": bench_fused,
         "cost": bench_cost,
+        "obs": bench_obs,
     }
     picked = suites.values() if args.suite == "all" else [suites[args.suite]]
 

@@ -500,6 +500,7 @@ _DOC_MODULE_ALIASES = {
     "pallas_conv": "parallel_cnn_tpu.ops.pallas_conv",
     "pallas_update": "parallel_cnn_tpu.ops.pallas_update",
     "pallas_tail": "parallel_cnn_tpu.ops.pallas_tail",
+    "obs": "parallel_cnn_tpu.obs",
 }
 _SYMBOL_RE = re.compile(r"`([a-z_][a-z0-9_]*)\.([a-z_][A-Za-z0-9_]*)\(")
 

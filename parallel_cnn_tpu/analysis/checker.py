@@ -45,6 +45,7 @@ LIVE_DOCS = (
     "docs/fault_tolerance.md",
     "docs/kernel_authoring.md",
     "docs/static_analysis.md",
+    "docs/observability.md",
     "docs/future_work.md",
 )
 

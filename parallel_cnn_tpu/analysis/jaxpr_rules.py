@@ -495,6 +495,25 @@ def trace_entry_points(
         None,
     ))
 
+    # Observability invariant (docs/observability.md): an obs span wraps
+    # host-side dispatch only, so a step traced UNDER an open span must
+    # yield a jaxpr free of callbacks/effects and clean under every rule
+    # — i.e. the compiled program is identical with tracing on or off.
+    # The span opens and closes on the host at trace time.
+    from parallel_cnn_tpu.obs.trace import Tracer
+
+    _obs_tracer = Tracer(process_name="graftcheck", mirror_jax=False)
+
+    def _obs_step(p, x, y):
+        with _obs_tracer.span("train.step", cat="step"):
+            return step.batched_step(p, x, y, 0.05)
+
+    out.append((
+        "train.obs_batched_step",
+        jax.make_jaxpr(_obs_step)(lp, lx, ly),
+        None,
+    ))
+
     from parallel_cnn_tpu.serve import registry as serve_registry
 
     sh = serve_registry.get("cifar_cnn")

@@ -16,12 +16,14 @@ import dataclasses
 import os
 from typing import List, Optional
 
+from parallel_cnn_tpu import obs as obs_lib
 from parallel_cnn_tpu.config import (
     CommConfig,
     Config,
     DataConfig,
     FusedStepConfig,
     MeshConfig,
+    ObsConfig,
     ResilienceConfig,
     ServeConfig,
     TrainConfig,
@@ -177,12 +179,50 @@ def build_parser() -> argparse.ArgumentParser:
                         "checkpoint (resilience/chaos.py)")
     p.add_argument("--metrics", default=None, metavar="PATH",
                    help="append JSONL metrics records to PATH")
+    _add_obs_flags(p)
     p.add_argument("--profile", action="store_true",
                    help="lenet_ref: print the per-phase table (paper "
                         "Tables 4-8 shape); zoo models: write a "
                         "jax.profiler trace of 3 steady-state train steps "
                         "to zoo_xla_trace/ under --checkpoint-dir (or cwd)")
     return p
+
+
+def _add_obs_flags(p: argparse.ArgumentParser) -> None:
+    """The shared observability flag surface (train, zoo, serve, loadgen).
+
+    Defaults keep observability fully OFF (the zero-cost no-op bundle);
+    PCNN_OBS_* env sets the base and these flags override field-by-field
+    (the comm-config layering)."""
+    p.add_argument("--trace", action="store_true",
+                   help="record host-side spans and the event journal; "
+                        "writes a Perfetto-loadable Chrome trace JSON and "
+                        "a JSONL journal under --trace-dir on exit "
+                        "[PCNN_OBS_TRACE]")
+    p.add_argument("--trace-dir", default=None, metavar="DIR",
+                   help="artifact directory for the trace + journal "
+                        "(implies --trace) [PCNN_OBS_DIR]")
+    p.add_argument("--metrics-json", default=None, metavar="PATH",
+                   help="write the metrics-registry JSON snapshot to PATH "
+                        "on exit (works without --trace: metrics-only "
+                        "mode) [PCNN_OBS_METRICS_JSON]")
+
+
+def _obs_config_from_args(args: argparse.Namespace):
+    """Optional[ObsConfig]: env first, flags override field-by-field;
+    everything unset → None (observability off, Config.obs default)."""
+    obs_cfg = ObsConfig.from_env()
+    if args.trace or args.trace_dir or args.metrics_json:
+        base = obs_cfg if obs_cfg is not None else ObsConfig(
+            trace=bool(args.trace or args.trace_dir)
+        )
+        obs_cfg = dataclasses.replace(
+            base,
+            trace=base.trace or bool(args.trace or args.trace_dir),
+            dir=args.trace_dir or base.dir,
+            metrics_json=args.metrics_json or base.metrics_json,
+        )
+    return obs_cfg
 
 
 def config_from_args(args: argparse.Namespace) -> Config:
@@ -257,7 +297,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         fused = dataclasses.replace(fused, act_dtype=args.act_dtype)
     return Config(data=data, train=train, mesh=mesh,
                   resilience=resilience, comm=comm, fused=fused,
-                  model=args.model)
+                  obs=_obs_config_from_args(args), model=args.model)
 
 
 def build_serve_parser(cmd: str) -> argparse.ArgumentParser:
@@ -323,6 +363,7 @@ def build_serve_parser(cmd: str) -> argparse.ArgumentParser:
                    help="payload + arrival-process seed (replayable)")
     p.add_argument("--json", default=None, metavar="PATH",
                    help="write the report/telemetry snapshot as JSON")
+    _add_obs_flags(p)
     return p
 
 
@@ -372,9 +413,14 @@ def _run_serve(cmd: str, argv: List[str]) -> int:
     from parallel_cnn_tpu.serve import get, loadgen, serve_stack
 
     handle = get(cfg.model, conv_backend=cfg.conv_backend)
+    obs_bundle = obs_lib.from_config(_obs_config_from_args(args), run=cmd)
     t0 = time.perf_counter()
-    pool, batcher = serve_stack(handle, cfg)
+    pool, batcher = serve_stack(handle, cfg, obs=obs_bundle)
     startup = time.perf_counter() - t0
+    if obs_bundle.enabled:
+        # Exposition parity: the ServeStats counters feed the registry's
+        # Prometheus/JSON snapshots without changing their semantics.
+        batcher.stats.attach_registry(obs_bundle.registry)
     src = cfg.checkpoint or "fresh init (no --checkpoint)"
     print(f"[serve] model={cfg.model} params from {src}")
     print(f"[serve] replicas={cfg.n_replicas} on "
@@ -432,6 +478,8 @@ def _run_serve(cmd: str, argv: List[str]) -> int:
             with open(args.json, "w") as f:
                 json_mod.dump(out, f, indent=2)
             print(f"[{cmd}] report written to {args.json}")
+    for kind, path in obs_bundle.finish().items():
+        print(f"[{cmd}] {kind} written to {path}")
     return 0
 
 
@@ -540,6 +588,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"resumed from {path} (epoch {start_epoch})")
 
     metrics = MetricsLogger(path=args.metrics) if args.metrics else None
+    obs_bundle = obs_lib.from_config(cfg.obs, run="train")
     remaining = max(cfg.train.epochs - start_epoch, 0)
     run_cfg = cfg.replace(
         train=dataclasses.replace(cfg.train, epochs=remaining)
@@ -572,8 +621,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             epoch_callback=on_epoch,
             chaos=chaos,
             ring=ring,
+            obs=obs_bundle,
         )
 
+    for kind, path in obs_bundle.finish().items():
+        print(f"[obs] {kind} written to {path}")
     if result.preempted or guard.preempted:
         if metrics:
             metrics.record(
@@ -694,6 +746,7 @@ def _run_zoo(args: argparse.Namespace, cfg: Config) -> int:
     else:
         batch = args.batch_size
     chaos = ChaosMonkey.from_spec(args.chaos) if args.chaos else None
+    obs_bundle = obs_lib.from_config(cfg.obs, run="zoo")
     with preempt.PreemptionGuard() as guard:
         zoo.train(
             model,
@@ -719,6 +772,7 @@ def _run_zoo(args: argparse.Namespace, cfg: Config) -> int:
             loader=args.zoo_loader,
             resilience=cfg.resilience,
             chaos=chaos,
+            obs=obs_bundle,
             # Zoo --profile = a jax.profiler trace of 3 steady-state steps
             # of THE run's own jitted step (augment/schedule/accum/mesh
             # included; compile excluded) — the single-chip MFU attribution
@@ -731,6 +785,8 @@ def _run_zoo(args: argparse.Namespace, cfg: Config) -> int:
                 else None
             ),
         )
+    for kind, path in obs_bundle.finish().items():
+        print(f"[obs] {kind} written to {path}")
     if guard.preempted:
         print("preempted: checkpoint flushed; continue with --resume")
     if metrics:

@@ -390,6 +390,57 @@ class ServeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ObsConfig:
+    """Observability policy (obs/ subsystem — span tracing with Perfetto
+    export, the process-wide metrics registry, and the JSONL event
+    journal; docs/observability.md has the artifact formats).
+
+    The default (no ObsConfig at all — Config.obs is None) keeps every
+    hot path on the zero-cost no-op bundle: no spans, no journal, no
+    files.  Constructing one (--trace / PCNN_OBS_* env) opts a run in.
+    """
+
+    # Emit host-side spans + the event journal and export the Chrome
+    # trace at the end of the run.
+    trace: bool = True
+    # Directory all trace/journal artifacts are written under.
+    dir: str = "obs_out"
+    # Path for a MetricsRegistry JSON snapshot at the end of the run;
+    # None = no snapshot file.  Setting only this (trace off) still
+    # enables the registry without any span/journal cost.
+    metrics_json: Optional[str] = None
+    # Mirror every span into jax.profiler.TraceAnnotation so XLA device
+    # profiles carry the same semantic names as the host timeline.
+    jax_annotations: bool = True
+
+    def __post_init__(self):
+        if not self.dir:
+            raise ValueError("ObsConfig.dir must be a non-empty path")
+
+    @property
+    def enabled(self) -> bool:
+        return self.trace or self.metrics_json is not None
+
+    @staticmethod
+    def from_env() -> Optional["ObsConfig"]:
+        """ObsConfig from PCNN_OBS_TRACE / PCNN_OBS_DIR /
+        PCNN_OBS_METRICS_JSON / PCNN_OBS_JAX, or None when none of them
+        is set (→ the no-op bundle everywhere)."""
+        trace = os.environ.get("PCNN_OBS_TRACE")
+        d = os.environ.get("PCNN_OBS_DIR")
+        mj = os.environ.get("PCNN_OBS_METRICS_JSON")
+        jx = os.environ.get("PCNN_OBS_JAX")
+        if trace is None and d is None and mj is None and jx is None:
+            return None
+        return ObsConfig(
+            trace=(trace if trace is not None else "1") not in ("0", ""),
+            dir=d or "obs_out",
+            metrics_json=mj or None,
+            jax_annotations=(jx or "1") != "0",
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
@@ -404,6 +455,9 @@ class Config:
     # round-7 fused path (update-on-arrival optimizer, fused loss tail,
     # bf16 activations with dynamic loss scaling).
     fused: Optional[FusedStepConfig] = None
+    # None = the zero-cost no-op observability bundle; an ObsConfig opts
+    # the run into span tracing / journal / metrics artifacts (obs/).
+    obs: Optional[ObsConfig] = None
     model: str = "lenet_ref"
 
     def replace(self, **kw) -> "Config":

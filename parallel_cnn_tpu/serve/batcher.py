@@ -31,6 +31,7 @@ from typing import Any, List, Optional
 
 import numpy as np
 
+from parallel_cnn_tpu import obs as obs_lib
 from parallel_cnn_tpu.serve.telemetry import ServeStats
 
 
@@ -106,12 +107,16 @@ class DynamicBatcher:
         deadline_ms: float = 0.0,
         stats: Optional[ServeStats] = None,
         start: bool = True,
+        obs: Optional["obs_lib.Obs"] = None,
     ):
         self.pool = pool
         self.max_batch = pool.max_batch
         self.max_wait_s = max_wait_ms / 1e3
         self.default_deadline_s = deadline_ms / 1e3 if deadline_ms else None
         self.stats = stats if stats is not None else ServeStats()
+        # Host-side observability hooks (spans around dispatch, request
+        # lifecycle journal events); the default no-op bundle is free.
+        self.obs = obs if obs is not None else obs_lib.NOOP
         self._queue: "queue_mod.Queue[_Request]" = queue_mod.Queue(
             maxsize=queue_depth
         )
@@ -163,10 +168,16 @@ class DynamicBatcher:
             deadline = now + deadline_ms / 1e3 if deadline_ms else None
         req = _Request(x, deadline, now)
         self.stats.on_submit()
+        if self.obs.enabled:
+            self.obs.event("submit", req=id(req.future))
+            self.obs.tracer.begin_async("request", id(req.future))
         try:
             self._queue.put_nowait(req)
         except queue_mod.Full:
             self.stats.on_shed()
+            if self.obs.enabled:
+                self.obs.event("shed", req=id(req.future))
+                self.obs.tracer.end_async("request", id(req.future))
             raise Overloaded(
                 f"request queue full ({self._queue.maxsize} deep); "
                 "back off and retry"
@@ -216,14 +227,15 @@ class DynamicBatcher:
                 continue
             batch = [first]
             t0 = time.monotonic()
-            while len(batch) < self.max_batch:
-                remaining = t0 + self.max_wait_s - time.monotonic()
-                if remaining <= 0:
-                    break
-                try:
-                    batch.append(self._queue.get(timeout=remaining))
-                except queue_mod.Empty:
-                    break
+            with self.obs.span("serve.coalesce", cat="serve"):
+                while len(batch) < self.max_batch:
+                    remaining = t0 + self.max_wait_s - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    try:
+                        batch.append(self._queue.get(timeout=remaining))
+                    except queue_mod.Empty:
+                        break
             now = time.monotonic()
             live: List[_Request] = []
             n_expired = 0
@@ -234,6 +246,9 @@ class DynamicBatcher:
                         "before dispatch"
                     ))
                     n_expired += 1
+                    if self.obs.enabled:
+                        self.obs.event("expired", req=id(r.future))
+                        self.obs.tracer.end_async("request", id(r.future))
                 else:
                     live.append(r)
             if n_expired:
@@ -244,12 +259,18 @@ class DynamicBatcher:
             seq = self._batch_seq
             # graftcheck: disable=lock-discipline -- _batch_seq is read and written only by this single worker thread
             self._batch_seq += 1
+            bucket = self.pool.engines[replica].bucket_for(len(live))
             self.stats.on_batch(
                 n=len(live),
-                bucket=self.pool.engines[replica].bucket_for(len(live)),
+                bucket=bucket,
                 replica=replica,
                 queue_depth=self._queue.qsize(),
             )
+            if self.obs.enabled:
+                self.obs.event(
+                    "batch", seq=seq, n=len(live), bucket=bucket,
+                    replica=replica, expired=n_expired,
+                )
             # Blocks when all runners are busy — deliberate backpressure
             # (see _dispatch's bound). Bail out on close.
             while not self._stop.is_set():
@@ -269,19 +290,33 @@ class DynamicBatcher:
 
     def _run_batch(self, live: List[_Request], replica: int, seq: int) -> None:
         try:
-            xs = np.stack([r.x for r in live])
-            ys, _ = self.pool.predict(xs, replica=replica)
+            with self.obs.span(
+                "serve.batch", cat="serve",
+                seq=seq, replica=replica, n=len(live),
+            ):
+                xs = np.stack([r.x for r in live])
+                ys, _ = self.pool.predict(xs, replica=replica)
             done = time.monotonic()
             for i, r in enumerate(live):
                 r.future.replica = replica
                 r.future.batch_seq = seq
                 r.future._resolve(ys[i])
                 self.stats.on_complete(done - r.t_submit)
+                if self.obs.enabled:
+                    self.obs.event(
+                        "complete", req=id(r.future), seq=seq,
+                        replica=replica,
+                        latency_ms=1e3 * (done - r.t_submit),
+                    )
+                    self.obs.tracer.end_async("request", id(r.future))
         except BaseException as e:  # noqa: BLE001 — forwarded to clients
             self.stats.on_failed(len(live))
             for r in live:
                 if not r.future.done():
                     r.future._fail(e)
+                if self.obs.enabled:
+                    self.obs.event("failed", req=id(r.future), seq=seq)
+                    self.obs.tracer.end_async("request", id(r.future))
 
 
 def serve_stack(
@@ -291,6 +326,7 @@ def serve_stack(
     devices=None,
     stats: Optional[ServeStats] = None,
     start: bool = True,
+    obs: Optional["obs_lib.Obs"] = None,
 ):
     """(pool, batcher) wired from a config.ServeConfig — the one-call
     constructor the CLI, benches, and dryrun share."""
@@ -303,6 +339,7 @@ def serve_stack(
         max_batch=cfg.max_batch,
         devices=devices,
         precompile=cfg.precompile,
+        obs=obs,
     )
     batcher = DynamicBatcher(
         pool,
@@ -311,5 +348,6 @@ def serve_stack(
         deadline_ms=cfg.deadline_ms,
         stats=stats,
         start=start,
+        obs=obs,
     )
     return pool, batcher

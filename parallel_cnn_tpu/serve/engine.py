@@ -33,6 +33,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from parallel_cnn_tpu import obs as obs_lib
+
 
 @dataclasses.dataclass
 class EngineStats:
@@ -115,6 +117,7 @@ class Engine:
         device=None,
         seed: int = 0,
         precompile: bool = False,
+        obs: Optional["obs_lib.Obs"] = None,
     ):
         import jax
 
@@ -124,6 +127,7 @@ class Engine:
             )
         self.handle = handle
         self.max_batch = max_batch
+        self.obs = obs if obs is not None else obs_lib.NOOP
         self.device = device if device is not None else jax.devices()[0]
         if params is None:
             params, model_state = load_or_init(handle, checkpoint, seed)
@@ -162,9 +166,13 @@ class Engine:
             sharding=SingleDeviceSharding(self.device),
         )
         t0 = time.perf_counter()
-        compiled = jax.jit(predict).lower(sds).compile()
+        with self.obs.span("serve.aot_compile", cat="serve", bucket=bucket):
+            compiled = jax.jit(predict).lower(sds).compile()
+        dt = time.perf_counter() - t0
         with self._lock:
-            self.stats.compile_seconds[bucket] = time.perf_counter() - t0
+            self.stats.compile_seconds[bucket] = dt
+        if self.obs.enabled:
+            self.obs.event("aot_compile", bucket=bucket, seconds=dt)
         return compiled
 
     def _executable(self, bucket: int):
@@ -249,6 +257,7 @@ class ReplicaPool:
         devices=None,
         seed: int = 0,
         precompile: bool = False,
+        obs: Optional["obs_lib.Obs"] = None,
     ):
         import jax
 
@@ -264,6 +273,7 @@ class ReplicaPool:
                 max_batch=max_batch,
                 device=devices[i % len(devices)],
                 precompile=precompile,
+                obs=obs,
             )
             for i in range(n_replicas)
         ]

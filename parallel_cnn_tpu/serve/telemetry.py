@@ -104,6 +104,15 @@ class ServeStats:
         snap["latency_ms"] = lat
         return snap
 
+    def attach_registry(self, registry, prefix: str = "serve") -> None:
+        """Expose this ServeStats through an obs.MetricsRegistry.
+
+        Registers ``snapshot`` as a collector, so every exposition
+        (Prometheus text or JSON) pulls the live counters under
+        ``<prefix>.*`` — the counters themselves keep their semantics
+        and locking; the registry never caches them."""
+        registry.attach(prefix, self.snapshot)
+
     def render(self) -> str:
         """Human-readable one-screen summary (the CLI's epilogue)."""
         s = self.snapshot()

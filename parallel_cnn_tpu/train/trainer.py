@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from parallel_cnn_tpu import obs as obs_lib
 from parallel_cnn_tpu.config import Config
 from parallel_cnn_tpu.data import pipeline
 from parallel_cnn_tpu.models import lenet_ref
@@ -125,6 +126,7 @@ def learn(
     epoch_callback=None,
     chaos=None,
     ring=None,
+    obs: Optional["obs_lib.Obs"] = None,
 ) -> TrainResult:
     """≙ learn() (Sequential/Main.cpp:146-184): epoch loop with mean
     err-norm metric and threshold early-stop.
@@ -152,6 +154,9 @@ def learn(
     """
     tc = cfg.train
     res = cfg.resilience
+    # Host-side observability: spans wrap dispatch/readback only, journal
+    # events mark epoch outcomes — nothing enters the jitted bodies.
+    obs = obs if obs is not None else obs_lib.NOOP
     if params is None:
         params = lenet_ref.init(jax.random.key(tc.seed))
     else:
@@ -250,12 +255,15 @@ def learn(
         return chaos.after_step(p, e) if chaos is not None else (p, e)
 
     epoch = 0
+    _chaos_logged = False
     while epoch < tc.epochs:
         # Per-epoch derived seed: every path reshuffles each epoch (and all
         # paths draw the same epoch boundary semantics — an epoch is one
         # pass from index 0, shuffled or in file order).
         epoch_seed = tc.seed + epoch_offset + epoch
-        with sw:
+        with sw, obs.span(
+            "train.epoch", cat="train", epoch=epoch_offset + epoch + 1
+        ):
             if tc.batch_size == 1:
                 if tc.shuffle:
                     perm = jnp.asarray(
@@ -320,12 +328,18 @@ def learn(
                     weights.append(bx.shape[0])
                 w = jnp.asarray(weights, jnp.float32)
                 err = jnp.sum(jnp.stack(errs) * w) / jnp.sum(w)
-            err = float(err)  # blocks: everything above is async
+            with obs.span("train.readback", cat="train"):
+                err = float(err)  # blocks: everything above is async
 
         if sentinel is not None:
             verdict = sentinel.check(loss=err, params=params)
             if not verdict.healthy:
                 g_epoch = epoch_offset + epoch + 1
+                if obs.enabled:
+                    obs.event(
+                        "verdict", healthy=False, epoch=g_epoch,
+                        reason=verdict.reason, policy=res.policy,
+                    )
                 if res.policy == "raise":
                     raise DivergenceError(
                         f"epoch {g_epoch}: {verdict.reason}"
@@ -345,6 +359,12 @@ def learn(
                     like=params, reason=f"epoch {g_epoch}: {verdict.reason}"
                 )
                 result.rollbacks = controller.rollbacks
+                if obs.enabled:
+                    obs.event(
+                        "rollback", epoch=g_epoch,
+                        rollbacks=controller.rollbacks,
+                        lr_scale=controller.lr_scale,
+                    )
                 new_dt = tc.dt * controller.lr_scale
                 if new_dt != dt:
                     dt = new_dt
@@ -356,9 +376,19 @@ def learn(
                 controller.commit(params)
 
         result.epoch_errors.append(err)
+        if obs.enabled:
+            obs.event(
+                "epoch", epoch=epoch_offset + epoch + 1, loss=err,
+                seconds=sw.total,
+            )
         if epoch_callback is not None:
             epoch_callback(epoch_offset + epoch + 1, params, err)
         if chaos is not None:
+            if obs.enabled and chaos.nan_fired and not _chaos_logged:
+                _chaos_logged = True
+                obs.event(
+                    "chaos", injected="nan", epoch=epoch_offset + epoch + 1
+                )
             chaos.at_epoch(epoch_offset + epoch + 1)
         if verbose:
             # ≙ fprintf at Sequential/Main.cpp:174
@@ -374,6 +404,8 @@ def learn(
             # checkpoint; stop at the boundary and let the driver exit
             # cleanly (--resume continues bit-exactly).
             result.preempted = True
+            if obs.enabled:
+                obs.event("preempt", epoch=epoch_offset + epoch + 1)
             if verbose:
                 print(
                     f"preemption: stopping after epoch "

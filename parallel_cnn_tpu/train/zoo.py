@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from parallel_cnn_tpu import obs as obs_lib
 from parallel_cnn_tpu.nn.core import Module
 from parallel_cnn_tpu.parallel.mesh import DATA_AXIS, HOST_AXIS
 
@@ -1224,6 +1225,7 @@ def train(
     profile_trace_dir: Optional[str] = None,
     resilience=None,
     chaos=None,
+    obs: Optional["obs_lib.Obs"] = None,
 ):
     """Epoch driver for zoo models on an in-memory dataset.
 
@@ -1304,6 +1306,11 @@ def train(
     """
     if loader not in ("device", "native"):
         raise ValueError(f"unknown loader {loader!r}")
+    # Host-side observability (obs/): spans wrap batch fetch, step
+    # dispatch, and the per-epoch readback; journal events mark epoch
+    # outcomes, sentinel verdicts, and the comm bucket plan. The default
+    # NOOP bundle makes all of it free.
+    obs = obs if obs is not None else obs_lib.NOOP
     steps = images.shape[0] // batch_size
     if steps == 0:
         raise ValueError(
@@ -1391,6 +1398,29 @@ def train(
         )
     ev_step = make_eval_step(model) if eval_data is not None else None
 
+    if (obs.enabled and comm is not None
+            and comm.impl in ("ring", "hierarchical")):
+        # Journal the bucket schedule once, host-side, from the same
+        # planner the jitted step uses — per-bucket *arrival* happens
+        # inside the compiled program where the host cannot observe it,
+        # so the plan (count, sizes, dtypes) is the honest signal.
+        from parallel_cnn_tpu.parallel import collectives
+
+        n_shards = mesh.shape[DATA_AXIS]
+        if HOST_AXIS in mesh.axis_names:
+            n_shards *= mesh.shape[HOST_AXIS]
+        _plan = collectives.plan_buckets(
+            state.params, comm.bucket_bytes, shards=n_shards
+        )
+        obs.event(
+            "comm_plan", impl=comm.impl, n_buckets=_plan.n_buckets,
+            bucket_bytes=comm.bucket_bytes, shards=n_shards,
+        )
+        for _bi, (_sz, _dt) in enumerate(
+            zip(_plan.bucket_sizes, _plan.bucket_dtypes)
+        ):
+            obs.event("comm_bucket", bucket=_bi, elements=_sz, dtype=_dt)
+
     from parallel_cnn_tpu.resilience import preempt
     from parallel_cnn_tpu.resilience.rollback import (
         CheckpointRing,
@@ -1414,6 +1444,11 @@ def train(
         nonlocal _skip_seen
         if isinstance(st.opt_state, FusedOptState):
             sk = int(st.opt_state.skipped)
+            if obs.enabled and sk != _skip_seen:
+                obs.event(
+                    "loss_scale", skipped=sk,
+                    scale=float(st.opt_state.scale),
+                )
             v = sentinel.check_scaled(
                 loss=loss_val, params=st.params,
                 skipped_before=_skip_seen, skipped_now=sk,
@@ -1494,6 +1529,7 @@ def train(
         if controller is not None:
             controller.commit(state)
     epoch = start_epoch
+    _chaos_logged = False
     while epoch < epochs:
         t0 = time.perf_counter()
         # Device-side loss accumulation: one host readback per epoch, so
@@ -1514,33 +1550,62 @@ def train(
                 for i in range(steps)
             )
         diverged = None
-        for i, (bx, by) in enumerate(batches):
+        batch_iter = enumerate(batches)
+        while True:
+            with obs.span("zoo.data", cat="data"):
+                item = next(batch_iter, None)
+            if item is None:
+                break
+            i, (bx, by) = item
             key = (
                 jax.random.fold_in(aug_base, epoch * steps + i)
                 if aug_fn is not None
                 else None
             )
-            state, loss = step(state, jnp.asarray(bx), jnp.asarray(by), key)
+            with obs.span("zoo.dispatch", cat="step"):
+                state, loss = step(
+                    state, jnp.asarray(bx), jnp.asarray(by), key
+                )
             if chaos is not None:
                 state, loss = chaos.after_step(state, loss)
+                if obs.enabled and chaos.nan_fired and not _chaos_logged:
+                    _chaos_logged = True
+                    obs.event(
+                        "chaos", injected="nan", step=i, epoch=epoch + 1
+                    )
             epoch_loss = epoch_loss + loss
             if (
                 sentinel is not None
                 and res.check_every_steps
                 and (i + 1) % res.check_every_steps == 0
             ):
-                verdict = health_check(float(loss), state)
+                step_loss = float(loss)
+                if obs.enabled:
+                    # The sentinel cadence already paid the host sync, so
+                    # journaling the step loss here is free of extra
+                    # readbacks.
+                    obs.event(
+                        "step_loss", epoch=epoch + 1, step=i,
+                        loss=step_loss,
+                    )
+                verdict = health_check(step_loss, state)
                 if not verdict.healthy:
                     diverged = f"step {i} of epoch {epoch + 1}: " + (
                         verdict.reason
                     )
                     break
-        mean_loss = float(epoch_loss) / max(steps, 1)
+        with obs.span("zoo.readback", cat="step"):
+            mean_loss = float(epoch_loss) / max(steps, 1)
         if diverged is None and sentinel is not None:
             verdict = health_check(mean_loss, state)
             if not verdict.healthy:
                 diverged = f"epoch {epoch + 1}: {verdict.reason}"
         if diverged is not None:
+            if obs.enabled:
+                obs.event(
+                    "verdict", healthy=False, epoch=epoch + 1,
+                    reason=diverged, policy=res.policy,
+                )
             if res.policy == "raise":
                 raise DivergenceError(diverged)
             if res.policy == "skip":
@@ -1552,6 +1617,11 @@ def train(
             # rollback: restore the last-good ZooState and retry the same
             # epoch (same seed → same shuffle/augment stream), bounded.
             state, _ = controller.rollback(like=state, reason=diverged)
+            if obs.enabled:
+                obs.event(
+                    "rollback", epoch=epoch + 1,
+                    rollbacks=controller.rollbacks,
+                )
             if verbose:
                 print(
                     f"sentinel: {diverged} — rolled back "
@@ -1564,6 +1634,10 @@ def train(
                 controller.commit(state)
         losses.append(mean_loss)
         seconds = time.perf_counter() - t0
+        if obs.enabled:
+            obs.event(
+                "epoch", epoch=epoch + 1, loss=mean_loss, seconds=seconds
+            )
         if eval_data is not None:
             est = state
             if use_zero3:
@@ -1595,6 +1669,8 @@ def train(
                     extra={"epoch_accs": list(accs)},
                 ),
             )
+            if obs.enabled:
+                obs.event("checkpoint", epoch=epoch + 1)
         if verbose:
             acc_txt = f", acc {accs[-1]:.2f}%" if eval_data is not None else ""
             print(
@@ -1606,6 +1682,8 @@ def train(
         if preempt.requested():
             # Checkpoint for this epoch is already flushed (ring.save
             # above); stop at the boundary so --resume continues exactly.
+            if obs.enabled:
+                obs.event("preempt", epoch=epoch + 1)
             if verbose:
                 print(f"preemption: stopping after epoch {epoch + 1}")
             break

@@ -92,3 +92,9 @@ def pytest_configure(config):
         "invariants, AST lint, Pallas VMEM budgets, concurrency lint + "
         "race harness)",
     )
+    config.addinivalue_line(
+        "markers",
+        "obs: observability-layer tests (obs/ — tracer nesting + "
+        "thread-safety, journal conservation under chaos, exposition "
+        "goldens, cross-host merge, config gating)",
+    )

@@ -307,6 +307,67 @@ def test_real_entry_points_are_clean():
     assert [d for d in diags if d.severity == Severity.ERROR] == []
 
 
+def test_obs_span_is_invisible_in_the_jaxpr():
+    """Clean twin of the observability invariant: tracing a step under
+    an open obs span yields the byte-identical jaxpr of the bare step
+    (the span lives on the host), and the fast entry set carries the
+    ``train.obs_batched_step`` entry that gates this."""
+    from parallel_cnn_tpu.models import lenet_ref
+    from parallel_cnn_tpu.obs.trace import Tracer
+    from parallel_cnn_tpu.train import step
+
+    lp = lenet_ref.init(jax.random.key(0))
+    lx = jnp.zeros((8, 28, 28), jnp.float32)
+    ly = jnp.zeros((8,), jnp.int32)
+    bare = jax.make_jaxpr(
+        lambda p, x, y: step.batched_step(p, x, y, 0.05)
+    )(lp, lx, ly)
+
+    tracer = Tracer(process_name="fixture", mirror_jax=False)
+
+    def spanned(p, x, y):
+        with tracer.span("train.step", cat="step"):
+            return step.batched_step(p, x, y, 0.05)
+
+    closed = jax.make_jaxpr(spanned)(lp, lx, ly)
+    assert str(closed) == str(bare)
+    # the span itself DID run — on the host, at trace time
+    assert any(
+        e.get("ph") == "X" and e["name"] == "train.step"
+        for e in tracer.events()
+    )
+    assert not [
+        d for d in jaxpr_rules.analyze_closed_jaxpr("fixture", closed)
+        if d.severity == Severity.ERROR
+    ]
+    entries = jaxpr_rules.trace_entry_points(fast=True)
+    assert "train.obs_batched_step" in {name for name, _ in entries}
+
+
+def test_obs_naive_inline_timing_trips_weak_type():
+    """Tripping twin: the wrong way to time a step — feeding the host
+    clock INTO the traced computation — enters as a weak-typed python
+    scalar argument, the retrace hazard the host-side tracer avoids."""
+    import time
+
+    from parallel_cnn_tpu.models import lenet_ref
+    from parallel_cnn_tpu.train import step
+
+    lp = lenet_ref.init(jax.random.key(0))
+    lx = jnp.zeros((8, 28, 28), jnp.float32)
+    ly = jnp.zeros((8,), jnp.int32)
+
+    def timed_step(p, x, y, t0):
+        out = step.batched_step(p, x, y, 0.05)
+        return out, t0
+
+    closed = jax.make_jaxpr(timed_step)(lp, lx, ly, time.perf_counter())
+    hits = _by_rule(
+        jaxpr_rules.analyze_closed_jaxpr("fixture", closed), "weak-type"
+    )
+    assert hits and "re-promotes per call site" in hits[0].message
+
+
 # ---------------------------------------------------------------------------
 # AST family (targeted checker path, same as the dryrun seeded leg)
 # ---------------------------------------------------------------------------
