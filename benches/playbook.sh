@@ -23,6 +23,12 @@
 #             traced-vs-untraced step throughput pairs; tracing must hold
 #             >= 0.95x untraced. CPU-only and self-contained — gates
 #             commits like comm-multihost; OBS_GATE is the contract line.
+#   elastic   elastic-runtime gate (benches/run.py --suite elastic):
+#             resize downtime / reshard-cost rows on an 8-virtual-device
+#             CPU mesh, gated on the 8->4->8 resize-lap loss parity
+#             (<= 1e-5) and pure-reshard bit-exactness. CPU-only and
+#             self-contained — gates commits like comm-multihost;
+#             ELASTIC_GATE is the contract line.
 #
 # All artifacts append/write under docs/ with the given tag (default: the
 # UTC date), so repeated runs accumulate evidence instead of overwriting.
@@ -74,6 +80,21 @@ if [ "$MODE" = "obs" ]; then
   RC=$?; echo "obs rc=$RC" >> "$LOG"
   # The gate line is the contract: traced throughput >= 0.95x untraced.
   grep -q 'OBS_GATE PASS' "$OUT" || RC=1
+  [ $RC -ne 0 ] && OVERALL=1
+  echo "=== playbook ${MODE} end rc=${OVERALL} $(date -u +%FT%TZ) ===" >> "$LOG"
+  exit $OVERALL
+fi
+
+if [ "$MODE" = "elastic" ]; then
+  echo "--- elastic resize gate ---" >> "$LOG"
+  OUT="docs/elastic_${TAG}.txt"
+  # 8 virtual devices: the lap's worlds (8 and 4) need a full-size mesh.
+  timeout 900 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python benches/run.py --quick --suite elastic > "$OUT" 2>&1
+  RC=$?; echo "elastic rc=$RC" >> "$LOG"
+  # The gate line is the contract: lap parity <= 1e-5 + bit-exact reshard.
+  grep -q 'ELASTIC_GATE PASS' "$OUT" || RC=1
   [ $RC -ne 0 ] && OVERALL=1
   echo "=== playbook ${MODE} end rc=${OVERALL} $(date -u +%FT%TZ) ===" >> "$LOG"
   exit $OVERALL

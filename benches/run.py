@@ -1290,6 +1290,205 @@ def bench_obs(quick: bool) -> List[Row]:
     return rows
 
 
+def bench_elastic(quick: bool) -> List[Row]:
+    """--suite elastic: resize downtime + reshard cost for the elastic
+    runtime (resilience/elastic.py), gated on the contracts the tests
+    pin.
+
+    Rows: the wall-clock cost of one ElasticController.resize (quiesce →
+    zero3_full_view snapshot → re-mesh → zero3_from_view reshard) in the
+    shrink (8→4) and grow (4→8) directions, the snapshot alone, and the
+    post-resize step throughput vs the same world trained from scratch
+    (the recompile is paid once; steady-state throughput must be
+    unchanged — the resharded state is the same layout a fresh init
+    produces).
+
+    The gate (ELASTIC_GATE, the playbook's contract line): an 8→4→8
+    resize lap matches the fixed-mesh loss trajectory to ≤ 1e-5 and a
+    zero-step reshard round trip is bit-exact. A violation appends an
+    error-unit row (nonzero exit) and flips the line to FAIL.
+
+    Needs ≥ 8 devices (the playbook mode forces 8 virtual CPU devices);
+    fewer is a labeled error row, not a crash."""
+    from parallel_cnn_tpu.config import (
+        CommConfig, ElasticConfig, FusedStepConfig, MeshConfig,
+    )
+    from parallel_cnn_tpu.nn import core as nn_core, layers as nn_layers
+    from parallel_cnn_tpu.parallel import mesh as mesh_lib
+    from parallel_cnn_tpu.resilience.elastic import ElasticController
+    from parallel_cnn_tpu.train import zoo
+
+    if len(jax.devices()) < 8:
+        raise RuntimeError(
+            f"elastic suite needs >= 8 devices, have {len(jax.devices())} "
+            "(run under XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            "as benches/playbook.sh elastic does)"
+        )
+
+    # The parity preconditions (tests/test_elastic.py pins both): f32
+    # activations and a BatchNorm-free model — bf16 rounding and
+    # per-shard BN stats are partition-dependent, so either would turn
+    # the ≤1e-5 gate into a numerics lottery.
+    shape = (8, 8, 3)
+    model = nn_core.Sequential([
+        nn_layers.Conv2D(4, (3, 3)), nn_layers.ReLU(),
+        nn_layers.MaxPool(), nn_layers.Flatten(), nn_layers.Dense(10),
+    ])
+    fused = FusedStepConfig(update=True, tail=True, act_dtype="float32",
+                            zero=3)
+    comm = CommConfig(impl="ring", bucket_bytes=2048, overlap=True)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(96, *shape)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 10, (96,)).astype(np.int32))
+    batches = [(x[i * 16:(i + 1) * 16], y[i * 16:(i + 1) * 16])
+               for i in range(6)]
+
+    def init8():
+        return zoo.init_zero3_state(
+            model, jax.random.key(7), shape, n_data=8, fused=fused,
+            bucket_bytes=comm.bucket_bytes,
+        )
+
+    def make_step(mesh, plan):
+        return zoo.make_zero3_train_step(
+            model, lr=0.05, momentum=0.9, accum_steps=2, mesh=mesh,
+            augment=None, comm=comm, fused=fused, plan=plan,
+        )
+
+    def full_view_np(st, plan):
+        return jax.tree_util.tree_map(
+            np.asarray, zoo.zero3_full_view(st, plan)
+        )
+
+    mesh8 = mesh_lib.make_mesh(MeshConfig(data=8, model=1))
+
+    # -- gate: fixed-mesh vs resize-lap loss parity ----------------------
+    st, plan = init8()
+    step = make_step(mesh8, plan)
+    fixed = []
+    for bx, by in batches:
+        st, loss = step(st, bx, by, None)
+        fixed.append(float(loss))
+
+    ctl = ElasticController(ElasticConfig(), world=8)
+    st, plan = init8()
+    mesh = mesh8
+    step = make_step(mesh, plan)
+    elastic = []
+    resize_ms = {}
+    for i, (bx, by) in enumerate(batches):
+        if i in (2, 4):
+            world = 4 if i == 2 else 8
+            jax.block_until_ready(jax.tree_util.tree_leaves(st))
+            t0 = time.perf_counter()
+            st, plan, mesh, _ = ctl.resize(
+                i, world, state=st, plan=plan, comm=comm,
+            )
+            jax.block_until_ready(jax.tree_util.tree_leaves(st))
+            resize_ms[f"{8 if world == 4 else 4}to{world}"] = round(
+                (time.perf_counter() - t0) * 1e3, 2
+            )
+            step = make_step(mesh, plan)
+        st, loss = step(st, bx, by, None)
+        elastic.append(float(loss))
+    lap_delta = max(abs(a - b) for a, b in zip(fixed, elastic))
+
+    # -- gate: pure reshard bit-exactness --------------------------------
+    v8 = full_view_np(st, plan)
+    st4, plan4 = zoo.zero3_from_view(
+        v8, n_data=4, bucket_bytes=comm.bucket_bytes
+    )
+    v4 = full_view_np(st4, plan4)
+    bitexact = all(
+        np.array_equal(a, b)
+        for a, b in zip(jax.tree_util.tree_leaves(v8),
+                        jax.tree_util.tree_leaves(v4))
+    )
+
+    # -- timing rows -----------------------------------------------------
+    rows: List[Row] = [
+        Row(f"elastic_resize_{name}_ms", ms, "ms",
+            baseline_src="quiesce + snapshot + re-mesh + reshard, "
+                         "blocked end to end").finish()
+        for name, ms in sorted(resize_ms.items())
+    ]
+    snap_st, snap_plan = init8()
+    t0 = time.perf_counter()
+    jax.block_until_ready(
+        jax.tree_util.tree_leaves(zoo.zero3_full_view(snap_st, snap_plan))
+    )
+    rows.append(Row(
+        "elastic_snapshot_ms",
+        round((time.perf_counter() - t0) * 1e3, 2), "ms",
+        baseline_src="zero3_full_view alone (the quiesce-time cost a "
+                     "preemption grace window must cover)",
+    ).finish())
+
+    # Post-resize steady state vs from-scratch at the same world: the
+    # resharded layout must train at the same rate.
+    repeats = 4 if quick else 10
+    mesh4 = mesh_lib.make_elastic_mesh(4)
+    bx, by = batches[0]
+
+    # Fresh state per sample: the zero3 step donates its input buffers,
+    # so a state captured once would be deleted after the first sample's
+    # warmup (same convention as bench_obs's per-sample init).
+    def fresh_scratch():
+        return zoo.init_zero3_state(
+            model, jax.random.key(7), shape, n_data=4, fused=fused,
+            bucket_bytes=comm.bucket_bytes,
+        )[0]
+
+    def fresh_resharded():
+        return zoo.zero3_from_view(
+            v8, n_data=4, bucket_bytes=comm.bucket_bytes
+        )[0]
+
+    scratch_plan = zoo.init_zero3_state(
+        model, jax.random.key(7), shape, n_data=4, fused=fused,
+        bucket_bytes=comm.bucket_bytes,
+    )[1]
+    ips = {}
+    for name, fresh, pl in (
+        ("from_scratch", fresh_scratch, scratch_plan),
+        ("post_resize", fresh_resharded, plan4),
+    ):
+        stp = make_step(mesh4, pl)
+
+        def thunk(carry, stp=stp, fresh=fresh):
+            cur = carry[0] if carry is not None else fresh()
+            return stp(cur, bx, by, None)
+
+        med, rng_, n = _sampled_ips(thunk, repeats, bx.shape[0])
+        ips[name] = med
+        rows.append(Row(
+            f"elastic_step4_{name}", med, "images/sec",
+            baseline=(ips["from_scratch"]
+                      if name == "post_resize" else None),
+            baseline_src=("vs from-scratch init at world 4"
+                          if name == "post_resize" else
+                          "fresh world-4 init"),
+            value_range=rng_, value_samples=n,
+        ).finish())
+
+    gate_ok = lap_delta <= 1e-5 and bitexact
+    if not gate_ok:
+        rows.append(Row(
+            "error_elastic_gate", -1.0, "error",
+            baseline_src=(
+                f"resize-lap max |dloss| {lap_delta:.3e} (gate 1e-5), "
+                f"pure reshard bitexact={bitexact}"
+            ),
+        ))
+    print(
+        f"ELASTIC_GATE {'PASS' if gate_ok else 'FAIL'}: 8-4-8 lap "
+        f"|dloss| {lap_delta:.2e} (<= 1e-5), pure reshard "
+        f"{'bit-exact' if bitexact else 'NOT bit-exact'}",
+        flush=True,
+    )
+    return rows
+
+
 def render_md(rows: List[Row]) -> str:
     lines = [
         "| benchmark | value | unit | reference baseline | speedup | samples |",
@@ -1321,7 +1520,8 @@ def main(argv=None) -> int:
         "--suite",
         default="all",
         choices=["all", "lenet", "phases", "dp", "zoo", "parity", "ops",
-                 "comm", "northstar", "serve", "fused", "cost", "obs"],
+                 "comm", "northstar", "serve", "fused", "cost", "obs",
+                 "elastic"],
     )
     args = ap.parse_args(argv)
 
@@ -1345,6 +1545,7 @@ def main(argv=None) -> int:
         "fused": bench_fused,
         "cost": bench_cost,
         "obs": bench_obs,
+        "elastic": bench_elastic,
     }
     picked = suites.values() if args.suite == "all" else [suites[args.suite]]
 

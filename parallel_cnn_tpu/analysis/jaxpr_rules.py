@@ -708,6 +708,33 @@ def trace_entry_points(
                     transient_gather_bytes=sum(zplanh.bucket_sizes) * 4,
                 ),
             ))
+
+    # Elastic post-resize entry (resilience/elastic.py): the step the
+    # trainer recompiles AFTER an in-flight shrink — the live ZeRO-3
+    # state round-tripped through zero3_full_view → zero3_from_view onto
+    # half the devices. The resharded step must satisfy every invariant
+    # a from-scratch step does (f32-master head gathers included): a
+    # reshard that smuggled a bf16 master or broke ring coverage would
+    # surface here, not at 3am on a preempted pod.
+    if n_dev >= 4 and n_dev % 2 == 0:
+        half = n_dev // 2
+        smesh = mesh_lib.make_elastic_mesh(half, devices=jax.devices())
+        view = zoo.zero3_full_view(zst, zplan)
+        rst, rplan = zoo.zero3_from_view(
+            view, n_data=half, bucket_bytes=ring_bf16.bucket_bytes
+        )
+        with smesh:
+            resize_step = zoo.make_zero3_train_step(
+                model, lr=0.01, momentum=0.9, accum_steps=2, mesh=smesh,
+                augment=None, comm=ring_bf16, fused=z3, plan=rplan,
+            )
+            rx = jnp.zeros((2 * half, *cifar.IN_SHAPE), jnp.float32)
+            ry = jnp.zeros((2 * half,), jnp.int32)
+            out.append((
+                "zoo.zero3_step.post_resize",
+                jax.make_jaxpr(resize_step)(rst, rx, ry),
+                None,
+            ))
     return _finish(out)
 
 

@@ -21,6 +21,7 @@ from parallel_cnn_tpu.config import (
     CommConfig,
     Config,
     DataConfig,
+    ElasticConfig,
     FusedStepConfig,
     MeshConfig,
     ObsConfig,
@@ -176,7 +177,38 @@ def build_parser() -> argparse.ArgumentParser:
                         "nan@STEP poisons the update at optimizer step "
                         "STEP; kill@EPOCH / kill9@EPOCH delivers "
                         "SIGTERM / SIGKILL after epoch EPOCH's "
-                        "checkpoint (resilience/chaos.py)")
+                        "checkpoint; resize@STEP:±K loses/adds K devices "
+                        "at optimizer step STEP (needs --elastic); "
+                        "kill-replica@SEQ kills the serving replica "
+                        "holding dispatch batch SEQ (serve path) "
+                        "(resilience/chaos.py has the full grammar)")
+    p.add_argument("--elastic", action="store_true",
+                   help="elastic training (PCNN_ELASTIC): on a preemption "
+                        "resize request, a chaos resize@, or a schedule "
+                        "entry, quiesce at the microbatch boundary, "
+                        "snapshot the ZeRO-3 state to a world-size-"
+                        "independent view, re-mesh over the surviving "
+                        "devices, reshard, and continue — no disk round "
+                        "trip, no restart (resilience/elastic.py). "
+                        "Requires the ZeRO-3 step (--fused-step path "
+                        "with zero=3 + --comm-impl ring/hierarchical)")
+    p.add_argument("--elastic-schedule", default=None, metavar="SPEC",
+                   help="planned resizes 'STEP:WORLD[,STEP:WORLD…]' — "
+                        "before optimizer step STEP resize the data "
+                        "world to WORLD (implies --elastic) "
+                        "[PCNN_ELASTIC_SCHEDULE]")
+    p.add_argument("--elastic-scaling", default=None,
+                   choices=["global", "per-device"],
+                   help="batch/LR response to a resize: global keeps the "
+                        "global batch + LR fixed (parity mode), "
+                        "per-device keeps the per-device batch and "
+                        "scales global batch + LR with the world "
+                        "(throughput mode) [PCNN_ELASTIC_SCALING]")
+    p.add_argument("--elastic-min-world", type=int, default=None,
+                   metavar="N",
+                   help="never shrink the data world below N devices; "
+                        "deeper chaos losses are clamped and journaled "
+                        "[PCNN_ELASTIC_MIN_WORLD]")
     p.add_argument("--metrics", default=None, metavar="PATH",
                    help="append JSONL metrics records to PATH")
     _add_obs_flags(p)
@@ -295,9 +327,28 @@ def config_from_args(args: argparse.Namespace) -> Config:
                 "--fused-step (or PCNN_FUSED_STEP=1) first"
             )
         fused = dataclasses.replace(fused, act_dtype=args.act_dtype)
+    # Same layering for the elastic runtime: PCNN_ELASTIC* env sets the
+    # base, any --elastic* flag overrides field-by-field (and opts in).
+    elastic = ElasticConfig.from_env()
+    if (args.elastic or args.elastic_schedule is not None
+            or args.elastic_scaling is not None
+            or args.elastic_min_world is not None):
+        base = elastic or ElasticConfig()
+        elastic = dataclasses.replace(
+            base,
+            enabled=True,
+            schedule=(args.elastic_schedule
+                      if args.elastic_schedule is not None
+                      else base.schedule),
+            scaling=args.elastic_scaling or base.scaling,
+            min_world=(args.elastic_min_world
+                       if args.elastic_min_world is not None
+                       else base.min_world),
+        )
     return Config(data=data, train=train, mesh=mesh,
                   resilience=resilience, comm=comm, fused=fused,
-                  obs=_obs_config_from_args(args), model=args.model)
+                  obs=_obs_config_from_args(args), elastic=elastic,
+                  model=args.model)
 
 
 def build_serve_parser(cmd: str) -> argparse.ArgumentParser:
@@ -566,6 +617,14 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if cfg.model != "lenet_ref":
         return _run_zoo(args, cfg)
+    if cfg.elastic is not None and cfg.elastic.enabled:
+        # The flat per-sample trainer has no sharded optimizer state to
+        # re-lay-out; only the zoo ZeRO-3 step can resize in flight.
+        raise SystemExit(
+            "--elastic needs the zoo ZeRO-3 trainer: pick a zoo --model "
+            "(e.g. cifar_cnn) with --mesh-data, --comm-impl ring and "
+            "--fused-step"
+        )
     train_ds, test_ds = pipeline.load_train_test(cfg.data)
 
     chaos = ChaosMonkey.from_spec(args.chaos) if args.chaos else None
@@ -773,6 +832,7 @@ def _run_zoo(args: argparse.Namespace, cfg: Config) -> int:
             resilience=cfg.resilience,
             chaos=chaos,
             obs=obs_bundle,
+            elastic=cfg.elastic,
             # Zoo --profile = a jax.profiler trace of 3 steady-state steps
             # of THE run's own jitted step (augment/schedule/accum/mesh
             # included; compile excluded) — the single-chip MFU attribution

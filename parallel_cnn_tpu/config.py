@@ -390,6 +390,89 @@ class ServeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ElasticConfig:
+    """Elastic-training policy (resilience/elastic.py — in-flight re-mesh
+    + ZeRO-3 reshard on preemption, chaos-injected device loss, or device
+    add; docs/fault_tolerance.md has the state machine).
+
+    The default (no ElasticConfig at all — Config.elastic is None) keeps
+    the historical fixed-mesh behavior: a preemption stops the run at the
+    next boundary, a lost device kills it.  Constructing one (--elastic /
+    PCNN_ELASTIC=1) opts the ZeRO-3 zoo trainer into resize-and-continue.
+    Requires the ZeRO-3 step (FusedStepConfig zero=3) — only there are
+    params/momentum resident as world-size-independent bucket-row shards
+    that zero3_full_view/zero3_from_view can re-lay-out without a disk
+    round-trip.
+    """
+
+    enabled: bool = True
+    # Deterministic resize schedule: "STEP:WORLD[,STEP:WORLD...]" —
+    # before optimizer step STEP (0-based, global across epochs), resize
+    # the data-parallel world to WORLD devices.  The planned test/dryrun
+    # surface; preemption signals and chaos `resize@` triggers feed the
+    # same controller at runtime.  Empty = no planned resizes.
+    schedule: str = ""
+    # How batch/LR respond to a world-size change:
+    #   "global"     — global batch and LR stay fixed; per-device batch
+    #                  changes implicitly with the mesh (the parity mode:
+    #                  the loss trajectory matches a fixed-mesh run up to
+    #                  reduction-order roundoff);
+    #   "per-device" — per-device batch stays fixed; global batch and LR
+    #                  scale linearly with the new world size (the
+    #                  throughput mode for genuine capacity changes).
+    scaling: str = "global"
+    # Never shrink below this many devices; a chaos `resize@N:-k` that
+    # would go under is clamped (and the clamp journaled).
+    min_world: int = 1
+
+    def __post_init__(self):
+        if self.scaling not in ("global", "per-device"):
+            raise ValueError(
+                f"unknown elastic scaling {self.scaling!r} "
+                "(global or per-device)"
+            )
+        if self.min_world < 1:
+            raise ValueError(
+                f"min_world must be >= 1, got {self.min_world}"
+            )
+        self.plan()  # validate the schedule grammar eagerly
+
+    def plan(self) -> tuple:
+        """The parsed schedule: ((step, world), ...) sorted by step."""
+        out = []
+        for part in filter(None, self.schedule.split(",")):
+            step, sep, world = part.partition(":")
+            if not sep or not step.strip().isdigit() \
+                    or not world.strip().isdigit():
+                raise ValueError(
+                    f"bad elastic schedule entry {part!r} "
+                    "(want STEP:WORLD, e.g. '40:4,80:8')"
+                )
+            out.append((int(step), int(world)))
+        return tuple(sorted(out))
+
+    @staticmethod
+    def from_env() -> Optional["ElasticConfig"]:
+        """ElasticConfig from PCNN_ELASTIC / PCNN_ELASTIC_SCHEDULE /
+        PCNN_ELASTIC_SCALING / PCNN_ELASTIC_MIN_WORLD, or None when none
+        of them is set (→ the historical fixed-mesh path)."""
+        enabled = os.environ.get("PCNN_ELASTIC")
+        schedule = os.environ.get("PCNN_ELASTIC_SCHEDULE")
+        scaling = os.environ.get("PCNN_ELASTIC_SCALING")
+        min_world = os.environ.get("PCNN_ELASTIC_MIN_WORLD")
+        if (enabled is None and schedule is None and scaling is None
+                and min_world is None):
+            return None
+        return ElasticConfig(
+            enabled=(enabled if enabled is not None else "1")
+            not in ("0", ""),
+            schedule=schedule or "",
+            scaling=scaling or "global",
+            min_world=int(min_world) if min_world else 1,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class ObsConfig:
     """Observability policy (obs/ subsystem — span tracing with Perfetto
     export, the process-wide metrics registry, and the JSONL event
@@ -458,6 +541,10 @@ class Config:
     # None = the zero-cost no-op observability bundle; an ObsConfig opts
     # the run into span tracing / journal / metrics artifacts (obs/).
     obs: Optional[ObsConfig] = None
+    # None = fixed-mesh training (preemption stops, device loss kills);
+    # an ElasticConfig opts the ZeRO-3 zoo trainer into in-flight
+    # re-mesh + reshard-and-continue (resilience/elastic.py).
+    elastic: Optional[ElasticConfig] = None
     model: str = "lenet_ref"
 
     def replace(self, **kw) -> "Config":

@@ -10,10 +10,15 @@ Event kinds written by the wired hot paths: ``epoch`` / ``step_loss``
 (trainer + zoo), ``loss_scale`` (dynamic loss-scaling skip/rescale),
 ``verdict`` (sentinel health checks), ``rollback``, ``checkpoint``,
 ``preempt``, ``chaos`` (injections), ``comm_plan`` / ``comm_bucket``
-(bucket schedule), ``aot_compile`` (serve engine), and the request
-lifecycle ``submit`` / ``shed`` / ``expired`` / ``batch`` / ``complete``
-/ ``failed`` — whose counts obey the same conservation law as
-``ServeStats``: submitted == completed + shed + expired + failed.
+(bucket schedule), ``aot_compile`` (serve engine), the elastic runtime's
+``resize_begin`` / ``resize_done`` (old/new world + host counts, trigger
+source, ring fallback — bracketing the ``train.resize`` span) and the
+failover path's ``replica_evicted`` / ``failover`` /
+``replica_respawned``, and the request lifecycle ``submit`` / ``shed``
+/ ``expired`` / ``batch`` / ``complete`` / ``failed`` — whose counts
+obey the same conservation law as ``ServeStats``: submitted ==
+completed + shed + expired + failed (and must keep obeying it across a
+mid-traffic replica death: failover re-resolves, never duplicates).
 """
 
 from __future__ import annotations

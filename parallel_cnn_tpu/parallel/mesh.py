@@ -124,6 +124,37 @@ def make_hier_mesh(n_hosts: Optional[int] = None,
     return Mesh(dev_array, (HOST_AXIS, DATA_AXIS))
 
 
+def make_elastic_mesh(world: int, *, n_hosts: int = 1,
+                      devices: Optional[Sequence] = None) -> Mesh:
+    """Rebuild the training mesh over the first ``world`` surviving
+    devices (resilience/elastic.py's re-mesh step).
+
+    The survivor set is deterministic: devices sort by
+    (process_index, id) — the same normalization make_hier_mesh applies —
+    and the first ``world`` are kept, so every process of a resizing run
+    rebuilds the identical mesh without coordination beyond agreeing on
+    ``world``. ``n_hosts > 1`` rebuilds hierarchically (host rows over
+    the survivors, so the two-level collectives keep working after a
+    host-count change); when ``world`` is no longer divisible by
+    ``n_hosts`` — e.g. a host lost some but not all of its devices — the
+    topology degrades to a flat data ring rather than refusing to
+    continue (the elastic contract is "keep training on what's left").
+    """
+    if world < 1:
+        raise ValueError(f"elastic world must be >= 1, got {world}")
+    devices = list(devices if devices is not None else jax.devices())
+    if world > len(devices):
+        raise ValueError(
+            f"elastic world {world} exceeds the {len(devices)} "
+            "reachable devices"
+        )
+    devices.sort(key=lambda d: (d.process_index, getattr(d, "id", 0)))
+    survivors = devices[:world]
+    if n_hosts > 1 and world % n_hosts == 0:
+        return make_hier_mesh(n_hosts=n_hosts, devices=survivors)
+    return make_mesh(MeshConfig(data=world, model=1), survivors)
+
+
 def hier_axis_sizes(mesh: Mesh):
     """(n_hosts, n_devices_per_host) of a make_hier_mesh mesh."""
     if HOST_AXIS not in mesh.axis_names:

@@ -22,6 +22,20 @@ tests/test_resilience.py drives training through it end-to-end. Faults:
   ``parallel_cnn_tpu.data.native`` raise ImportError (via the
   PCNN_DISABLE_NATIVE hook that module checks before touching the
   toolchain), proving the NumPy fallbacks engage.
+- **Device add/remove at step N** (``resize_delta=(N, ±k)``, spec
+  ``resize@N:±k``): before optimizer step N (host-side, 0-based, counted
+  across epochs) the elastic controller is told the data-parallel world
+  changed by k devices — the in-flight re-mesh + ZeRO-3 reshard path
+  (resilience/elastic.py). One-shot, like ``nan@``.
+- **Replica death at batch N** (``kill_replica_seq=N``, spec
+  ``kill-replica@N``): the serving replica about to execute dispatched
+  batch N dies (serve.ReplicaDead) — the ReplicaPool failover path:
+  evict, retry the in-flight batch on a survivor, re-pin a replacement.
+  One-shot.
+
+The full CLI spec grammar (documented here, consumed by ``from_spec``):
+``nan@STEP`` | ``kill@EPOCH`` | ``kill9@EPOCH`` | ``resize@STEP:±K`` |
+``kill-replica@SEQ``.
 
 No wall clocks, no unseeded randomness — a chaos run replays exactly.
 """
@@ -65,13 +79,23 @@ class ChaosMonkey:
         nan_step: Optional[int] = None,
         kill_epoch: Optional[int] = None,
         kill_signal: int = signal.SIGTERM,
+        resize_delta: Optional[Tuple[int, int]] = None,
+        kill_replica_seq: Optional[int] = None,
     ):
         self.nan_step = nan_step
         self.kill_epoch = kill_epoch
         self.kill_signal = kill_signal
+        # (step, ±k): before optimizer step `step`, the world gains/loses
+        # k devices (resilience/elastic.py polls resize_at each step).
+        self.resize_delta = resize_delta
+        # Dispatched-batch sequence number at which the executing serve
+        # replica dies (serve/batcher.py polls kill_replica_at).
+        self.kill_replica_seq = kill_replica_seq
         self.steps_seen = 0
         self.nan_fired = False
         self.kill_fired = False
+        self.resize_fired = False
+        self.kill_replica_fired = False
 
     def after_step(self, tree: Any, loss: Any) -> Tuple[Any, Any]:
         """Post-step hook: returns (possibly poisoned) (tree, loss)."""
@@ -96,15 +120,61 @@ class ChaosMonkey:
             self.kill_fired = True
             os.kill(os.getpid(), self.kill_signal)
 
+    def resize_at(self, step: int) -> Optional[int]:
+        """Pre-step hook (elastic controller): the one-shot world-size
+        delta (±k) to apply before optimizer step ``step``, else None."""
+        if (
+            self.resize_delta is not None
+            and not self.resize_fired
+            and step >= self.resize_delta[0]
+        ):
+            self.resize_fired = True
+            return self.resize_delta[1]
+        return None
+
+    def kill_replica_at(self, seq: int) -> bool:
+        """Dispatch hook (serve batcher): True exactly once, for the
+        replica about to execute dispatched batch ``seq``."""
+        if (
+            self.kill_replica_seq is not None
+            and not self.kill_replica_fired
+            and seq >= self.kill_replica_seq
+        ):
+            self.kill_replica_fired = True
+            return True
+        return False
+
     @classmethod
     def from_spec(cls, spec: str) -> "ChaosMonkey":
-        """Parse a CLI fault spec: ``nan@STEP``, ``kill@EPOCH`` (SIGTERM),
-        or ``kill9@EPOCH`` (SIGKILL)."""
+        """Parse a CLI fault spec (full grammar in the module docstring):
+        ``nan@STEP``, ``kill@EPOCH`` (SIGTERM), ``kill9@EPOCH`` (SIGKILL),
+        ``resize@STEP:±K`` (elastic world-size delta at step STEP), or
+        ``kill-replica@SEQ`` (serve replica death at dispatched batch
+        SEQ)."""
         kind, sep, arg = spec.partition("@")
-        if not sep or not arg.isdigit():
+        if not sep or not arg:
             raise ValueError(
-                f"bad chaos spec {spec!r}; expected nan@STEP, kill@EPOCH "
-                "or kill9@EPOCH"
+                f"bad chaos spec {spec!r}; expected nan@STEP, kill@EPOCH, "
+                "kill9@EPOCH, resize@STEP:±K or kill-replica@SEQ"
+            )
+        if kind == "resize":
+            step, ssep, delta = arg.partition(":")
+            try:
+                if not ssep:
+                    raise ValueError(arg)
+                d = int(delta)  # accepts +k / -k
+                if d == 0:
+                    raise ValueError(arg)
+                return cls(resize_delta=(int(step), d))
+            except ValueError:
+                raise ValueError(
+                    f"bad chaos spec {spec!r}; resize wants "
+                    "resize@STEP:±K with nonzero K (e.g. resize@40:-4)"
+                ) from None
+        if not arg.isdigit():
+            raise ValueError(
+                f"bad chaos spec {spec!r}; expected nan@STEP, kill@EPOCH, "
+                "kill9@EPOCH, resize@STEP:±K or kill-replica@SEQ"
             )
         n = int(arg)
         if kind == "nan":
@@ -113,6 +183,8 @@ class ChaosMonkey:
             return cls(kill_epoch=n, kill_signal=signal.SIGTERM)
         if kind == "kill9":
             return cls(kill_epoch=n, kill_signal=signal.SIGKILL)
+        if kind == "kill-replica":
+            return cls(kill_replica_seq=n)
         raise ValueError(f"unknown chaos fault {kind!r} in {spec!r}")
 
 

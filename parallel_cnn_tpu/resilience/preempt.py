@@ -81,6 +81,53 @@ def reset() -> None:
     _flag.clear()
 
 
+# --- elastic resize channel -------------------------------------------
+#
+# Same flag-based shape as the shutdown path, but carrying a payload: a
+# scheduler (or an operator via a future SIGUSR handler) announces "the
+# data-parallel world is about to become N devices"; the elastic
+# controller (resilience/elastic.py) consumes it at the next microbatch
+# boundary and re-meshes instead of stopping. Distinct from the shutdown
+# flag on purpose — a resize request must NOT make PreemptionGuard report
+# the run as preempted.
+
+_resize_lock = threading.Lock()
+_resize_world: list = []  # empty = no pending request; else [target_world]
+
+
+def request_resize(world: int) -> None:
+    """Announce a pending world-size change to ``world`` devices.
+
+    Thread-safe (watchdog threads / test harnesses call it); the newest
+    request wins if several arrive between polls."""
+    if world < 1:
+        raise ValueError(f"resize target must be >= 1, got {world}")
+    with _resize_lock:
+        # graftcheck: disable=global-mutation -- guarded by _resize_lock one line up; the lint doesn't model module-level locks
+        _resize_world[:] = [world]
+    log.warning(
+        "resize requested: world -> %d at the next microbatch boundary",
+        world,
+    )
+
+
+def resize_requested() -> "int | None":
+    """The pending target world size, or None. Does not consume it."""
+    with _resize_lock:
+        return _resize_world[0] if _resize_world else None
+
+
+def clear_resize() -> "int | None":
+    """Consume and return the pending resize request (None if absent)."""
+    with _resize_lock:
+        if _resize_world:
+            world = _resize_world[0]
+            # graftcheck: disable=global-mutation -- guarded by _resize_lock (the enclosing `with`); the lint doesn't model module-level locks
+            _resize_world.clear()
+            return world
+        return None
+
+
 class PreemptionGuard:
     """Scoped install/uninstall; reads back whether a preemption fired.
 

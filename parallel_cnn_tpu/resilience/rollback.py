@@ -117,6 +117,34 @@ class CheckpointRing:
                 log.warning("skipping unusable checkpoint %s: %s", path, e)
         return None
 
+    def restore_latest_sharded(
+        self, like
+    ) -> Optional[Tuple[Any, "checkpoint.TrainState", dict, str]]:
+        """(view, state, zero3-meta, path) from the newest SHARDED
+        checkpoint that loads, or None.
+
+        The ZeRO-3 twin of restore_latest: the ring written by the zoo
+        trainer's save_sharded closure holds sharded files that
+        ``restore`` (and hence restore_latest) refuses by design, so the
+        elastic snapshot-fallback path needs this reader. Unreadable,
+        corrupt, unsharded, or template-mismatched files are warned about
+        (ShardedCheckpointError carries the writer rank + world size)
+        and skipped — partial-ring recovery means falling through to the
+        newest file that still serves the requesting mesh.
+        """
+        for tag in self.tags():
+            path = self.path_for(tag)
+            try:
+                view, state, zmeta = _checkpoint().restore_sharded(
+                    path, like
+                )
+                return view, state, zmeta, path
+            except ValueError as e:
+                log.warning(
+                    "skipping unusable sharded checkpoint %s: %s", path, e
+                )
+        return None
+
 
 class RollbackController:
     """Bounded auto-rollback to the last sentinel-approved state."""

@@ -32,6 +32,7 @@ from typing import Any, List, Optional
 import numpy as np
 
 from parallel_cnn_tpu import obs as obs_lib
+from parallel_cnn_tpu.serve.engine import ReplicaDead
 from parallel_cnn_tpu.serve.telemetry import ServeStats
 
 
@@ -108,8 +109,14 @@ class DynamicBatcher:
         stats: Optional[ServeStats] = None,
         start: bool = True,
         obs: Optional["obs_lib.Obs"] = None,
+        chaos=None,
     ):
         self.pool = pool
+        # Fault injector (resilience.chaos.ChaosMonkey): kill_replica_at
+        # fires on the dispatch batch sequence number, killing the target
+        # replica the instant before its predict — the mid-traffic death
+        # the failover path exists for.
+        self.chaos = chaos
         self.max_batch = pool.max_batch
         self.max_wait_s = max_wait_ms / 1e3
         self.default_deadline_s = deadline_ms / 1e3 if deadline_ms else None
@@ -289,34 +296,117 @@ class DynamicBatcher:
             self._run_batch(live, replica, seq)
 
     def _run_batch(self, live: List[_Request], replica: int, seq: int) -> None:
+        if self.chaos is not None and self.chaos.kill_replica_at(seq):
+            # Chaos: the replica dies the instant before its predict —
+            # the dispatch already committed to it, so the failure is
+            # observed exactly where a real mid-traffic device loss
+            # would surface (predict raises ReplicaDead).
+            self.pool.kill(replica)
         try:
             with self.obs.span(
                 "serve.batch", cat="serve",
                 seq=seq, replica=replica, n=len(live),
             ):
-                xs = np.stack([r.x for r in live])
-                ys, _ = self.pool.predict(xs, replica=replica)
-            done = time.monotonic()
-            for i, r in enumerate(live):
-                r.future.replica = replica
-                r.future.batch_seq = seq
-                r.future._resolve(ys[i])
-                self.stats.on_complete(done - r.t_submit)
+                self._resolve_batch(live, replica, seq)
+        except ReplicaDead:
+            self._failover(live, replica, seq)
+        except BaseException as e:  # noqa: BLE001 — forwarded to clients
+            self._fail_batch(live, seq, e)
+
+    def _resolve_batch(self, live: List[_Request], replica: int,
+                       seq: int) -> None:
+        """Predict + resolve, the single dispatch site — _run_batch's
+        normal path and _failover's retry both land here. ReplicaDead
+        propagates to the caller BEFORE any future resolves (the predict
+        raises up front), so a retried batch is still whole."""
+        xs = np.stack([r.x for r in live])
+        ys, _ = self.pool.predict(xs, replica=replica)
+        done = time.monotonic()
+        for i, r in enumerate(live):
+            r.future.replica = replica
+            r.future.batch_seq = seq
+            r.future._resolve(ys[i])
+            self.stats.on_complete(done - r.t_submit)
+            if self.obs.enabled:
+                self.obs.event(
+                    "complete", req=id(r.future), seq=seq,
+                    replica=replica,
+                    latency_ms=1e3 * (done - r.t_submit),
+                )
+                self.obs.tracer.end_async("request", id(r.future))
+
+    def _fail_batch(self, live: List[_Request], seq: int,
+                    e: BaseException) -> None:
+        """The historic fail-all contract: every request in the batch
+        resolves exactly once, with the error, and is counted failed."""
+        self.stats.on_failed(len(live))
+        for r in live:
+            if not r.future.done():
+                r.future._fail(e)
+            if self.obs.enabled:
+                self.obs.event("failed", req=id(r.future), seq=seq)
+                self.obs.tracer.end_async("request", id(r.future))
+
+    def _failover(self, live: List[_Request], dead: int, seq: int) -> None:
+        """Replica ``dead`` died with this batch in flight: evict it,
+        retry the still-within-deadline requests on a survivor, and
+        re-pin a replacement.
+
+        Conservation holds across the detour — every request in ``live``
+        resolves exactly once: completed (retry landed), expired (its
+        deadline passed before the retry could dispatch), or failed (the
+        retry itself failed / no survivor was available)."""
+        self.pool.evict(dead)
+        if self.obs.enabled:
+            self.obs.event("replica_evicted", replica=dead, seq=seq)
+        now = time.monotonic()
+        retry: List[_Request] = []
+        n_expired = 0
+        for r in live:
+            if r.deadline is not None and now > r.deadline:
+                r.future._fail(DeadlineExceeded(
+                    f"deadline passed "
+                    f"{1e3 * (now - r.deadline):.1f} ms into replica "
+                    f"failover"
+                ))
+                n_expired += 1
+                if self.obs.enabled:
+                    self.obs.event("expired", req=id(r.future))
+                    self.obs.tracer.end_async("request", id(r.future))
+            else:
+                retry.append(r)
+        if n_expired:
+            self.stats.on_expired(n_expired)
+        respawned = False
+        try:
+            if retry:
+                try:
+                    survivor = self.pool.next_replica()
+                except ReplicaDead:
+                    # Single-replica pool (or total loss): the
+                    # replacement IS the survivor.
+                    survivor = self.pool.respawn(dead)
+                    respawned = True
+                    if self.obs.enabled:
+                        self.obs.event(
+                            "replica_respawned", replica=dead, seq=seq
+                        )
                 if self.obs.enabled:
                     self.obs.event(
-                        "complete", req=id(r.future), seq=seq,
-                        replica=replica,
-                        latency_ms=1e3 * (done - r.t_submit),
+                        "failover", seq=seq, dead=dead,
+                        survivor=survivor, n=len(retry),
+                        expired=n_expired,
                     )
-                    self.obs.tracer.end_async("request", id(r.future))
+                self._resolve_batch(retry, survivor, seq)
         except BaseException as e:  # noqa: BLE001 — forwarded to clients
-            self.stats.on_failed(len(live))
-            for r in live:
-                if not r.future.done():
-                    r.future._fail(e)
+            self._fail_batch(retry, seq, e)
+        finally:
+            if not respawned:
+                self.pool.respawn(dead)
                 if self.obs.enabled:
-                    self.obs.event("failed", req=id(r.future), seq=seq)
-                    self.obs.tracer.end_async("request", id(r.future))
+                    self.obs.event(
+                        "replica_respawned", replica=dead, seq=seq
+                    )
 
 
 def serve_stack(
@@ -327,9 +417,11 @@ def serve_stack(
     stats: Optional[ServeStats] = None,
     start: bool = True,
     obs: Optional["obs_lib.Obs"] = None,
+    chaos=None,
 ):
     """(pool, batcher) wired from a config.ServeConfig — the one-call
-    constructor the CLI, benches, and dryrun share."""
+    constructor the CLI, benches, and dryrun share. ``chaos`` (a
+    resilience.chaos.ChaosMonkey) arms kill-replica fault injection."""
     from parallel_cnn_tpu.serve.engine import ReplicaPool
 
     pool = ReplicaPool(
@@ -349,5 +441,6 @@ def serve_stack(
         stats=stats,
         start=start,
         obs=obs,
+        chaos=chaos,
     )
     return pool, batcher

@@ -46,6 +46,17 @@ class EngineStats:
     compile_seconds: Dict[int, float] = dataclasses.field(default_factory=dict)
 
 
+class ReplicaDead(RuntimeError):
+    """A replica is gone — killed by chaos (``kill-replica@SEQ``) or
+    evicted after a real device failure. Carries the replica index so the
+    batcher's failover path can evict/respawn exactly the dead one and
+    retry the in-flight batch on a survivor."""
+
+    def __init__(self, replica: int, message: str = ""):
+        super().__init__(message or f"replica {replica} is dead")
+        self.replica = replica
+
+
 def load_or_init(handle, checkpoint: Optional[str] = None, seed: int = 0):
     """(params, model_state) for a handle — restored from a checkpoint
     when given, else fresh-initialized from ``seed``.
@@ -245,6 +256,13 @@ class ReplicaPool:
     concurrently (no shared compile cache, no shared device queue).
     Replica selection (`next_replica`) is a deterministic round-robin —
     tests replay it exactly.
+
+    Failure-aware: ``kill``/``evict`` mark a replica dead (its predict
+    raises ReplicaDead, round-robin skips it), ``respawn`` re-pins a
+    fresh Engine from the host-side weight copies the pool keeps for
+    exactly this purpose. The batcher's failover path drives the
+    evict → retry-on-survivor → respawn sequence (chaos
+    ``kill-replica@SEQ`` is the test harness for it).
     """
 
     def __init__(
@@ -265,6 +283,13 @@ class ReplicaPool:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         devices = list(devices) if devices is not None else jax.devices()
         params, model_state = load_or_init(handle, checkpoint, seed)
+        # Kept host-side for respawn: a replacement replica re-pins these
+        # (the dead replica's device copies are unreachable by definition).
+        self._params = params
+        self._model_state = model_state
+        self.devices = devices
+        self._precompile = precompile
+        self.obs = obs
         self.engines = [
             Engine(
                 handle,
@@ -280,17 +305,66 @@ class ReplicaPool:
         self.handle = handle
         self.max_batch = max_batch
         self._rr = 0
+        self._alive = [True] * n_replicas
         self._lock = threading.Lock()
 
     @property
     def n_replicas(self) -> int:
         return len(self.engines)
 
-    def next_replica(self) -> int:
+    def alive(self) -> List[int]:
+        """Indices of live replicas."""
         with self._lock:
-            i = self._rr
-            self._rr = (self._rr + 1) % len(self.engines)
-            return i
+            return [i for i, a in enumerate(self._alive) if a]
+
+    def kill(self, i: int) -> None:
+        """Mark replica ``i`` dead: its predict raises ReplicaDead and
+        round-robin skips it until ``respawn``. The chaos injection point
+        (``kill-replica@SEQ``) — and what ``evict`` aliases after a real
+        failure."""
+        with self._lock:
+            self._alive[i] = False
+
+    # Eviction after an observed failure is the same state change as a
+    # chaos kill — one implementation, two call sites with different
+    # intents (inject vs respond).
+    evict = kill
+
+    def respawn(self, i: int, device=None) -> int:
+        """Re-pin a replacement for replica ``i`` from the pool's
+        host-side weights; returns ``i`` (now live again).
+
+        ``device`` overrides the pin (default: the slot's original
+        ``devices[i % len(devices)]`` assignment — on a CPU/chaos run the
+        device object is still healthy; a real device loss passes the
+        replacement device here). The fresh Engine has an empty AOT
+        cache: buckets recompile lazily on first use (or eagerly when the
+        pool was built with ``precompile=True``)."""
+        eng = Engine(
+            self.handle,
+            params=self._params,
+            model_state=self._model_state,
+            max_batch=self.max_batch,
+            device=device if device is not None
+            else self.devices[i % len(self.devices)],
+            precompile=self._precompile,
+            obs=self.obs,
+        )
+        with self._lock:
+            self.engines[i] = eng
+            self._alive[i] = True
+        return i
+
+    def next_replica(self) -> int:
+        """Deterministic round-robin over LIVE replicas (dead slots are
+        skipped without consuming a turn for the survivors)."""
+        with self._lock:
+            for _ in range(len(self.engines)):
+                i = self._rr
+                self._rr = (self._rr + 1) % len(self.engines)
+                if self._alive[i]:
+                    return i
+        raise ReplicaDead(-1, "no live replicas in the pool")
 
     def precompile(self) -> Dict[int, float]:
         out: Dict[int, float] = {}
@@ -300,6 +374,11 @@ class ReplicaPool:
 
     def predict(self, x, replica: Optional[int] = None) -> Tuple[np.ndarray, int]:
         """Run one batch on a replica (round-robin unless pinned).
-        Returns (outputs, replica index) so callers can audit placement."""
+        Returns (outputs, replica index) so callers can audit placement.
+        A pinned dead replica raises ReplicaDead — the batcher failover
+        trigger."""
         i = self.next_replica() if replica is None else replica
+        with self._lock:
+            if not self._alive[i]:
+                raise ReplicaDead(i)
         return self.engines[i].predict(x), i

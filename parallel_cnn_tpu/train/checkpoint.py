@@ -28,6 +28,30 @@ import numpy as np
 FORMAT_VERSION = 1
 
 
+class ShardedCheckpointError(ValueError):
+    """A ZeRO-3 sharded checkpoint could not serve the requesting mesh.
+
+    Still a ValueError (every existing skip-to-older-file path keeps
+    working), but carries the actionable coordinates the elastic restore
+    path needs to report: WHICH file, written by WHICH rank, at WHAT
+    world size — so "rank 3's shards are unreachable after the resize"
+    reads as exactly that instead of a bare KeyError.
+    """
+
+    def __init__(self, message: str, *, path: str,
+                 rank: Optional[int] = None,
+                 world_size: Optional[int] = None):
+        coords = [f"path={path!r}"]
+        if rank is not None:
+            coords.append(f"writer rank={rank}")
+        if world_size is not None:
+            coords.append(f"expected world size={world_size}")
+        super().__init__(f"{message} [{', '.join(coords)}]")
+        self.path = path
+        self.rank = rank
+        self.world_size = world_size
+
+
 @dataclass
 class TrainState:
     """What resume needs beyond the weights."""
@@ -95,7 +119,14 @@ def save_sharded(path: str, view, state: Optional[TrainState] = None, *,
     a typed error instead of mis-reading sharded state.
     """
     meta = _meta_for(state or TrainState())
-    meta["zero3"] = {"world_size": world_size, "bucket_bytes": bucket_bytes}
+    meta["zero3"] = {
+        "world_size": world_size,
+        "bucket_bytes": bucket_bytes,
+        # Writer rank: which process produced this file. Diagnostic only
+        # (the view is complete, not a per-rank shard slice), but it lets
+        # a partial-ring recovery error name the unreachable writer.
+        "rank": jax.process_index(),
+    }
     _write_atomic(path, view, meta)
 
 
@@ -226,24 +257,36 @@ def restore_sharded(path: str, like) -> Tuple[Any, TrainState, Dict[str, Any]]:
     independent, so the SAME template matches regardless of how many
     devices wrote the file — rebuilding resident shards for the current
     mesh is zoo.zero3_from_view's job (reshard-on-restore). Handing this
-    reader an unsharded checkpoint is a typed ValueError, mirroring
-    restore's rejection in the other direction.
+    reader an unsharded checkpoint, or a sharded file whose stored view
+    doesn't match the template, raises ShardedCheckpointError — a
+    ValueError subclass naming the file, its writer rank, and the world
+    size it was written at, so the elastic partial-ring recovery path
+    reports WHICH rank's checkpoint failed instead of a bare KeyError.
     """
     stored, meta = _read_arrays(path)
     if not meta.get("zero3"):
-        raise ValueError(
-            f"{path!r} is not a sharded checkpoint (no zero3 metadata) — "
-            "use restore/load_params"
+        raise ShardedCheckpointError(
+            "not a sharded checkpoint (no zero3 metadata) — "
+            "use restore/load_params",
+            path=path,
         )
+    z = meta["zero3"]
     want = _flatten(like)
     if set(stored) != set(want):
         missing = set(want) - set(stored)
         surplus = set(stored) - set(want)
-        raise ValueError(
+        raise ShardedCheckpointError(
             f"sharded checkpoint structure mismatch: "
-            f"missing={sorted(missing)} surplus={sorted(surplus)}"
+            f"missing={sorted(missing)} surplus={sorted(surplus)}",
+            path=path, rank=z.get("rank"), world_size=z.get("world_size"),
         )
-    _check_leaves(stored, want)
+    try:
+        _check_leaves(stored, want)
+    except ValueError as e:
+        raise ShardedCheckpointError(
+            str(e), path=path, rank=z.get("rank"),
+            world_size=z.get("world_size"),
+        ) from e
     view = _unflatten_into(like, stored)
     state = TrainState(
         epoch=meta["epoch"],

@@ -1226,6 +1226,7 @@ def train(
     resilience=None,
     chaos=None,
     obs: Optional["obs_lib.Obs"] = None,
+    elastic=None,
 ):
     """Epoch driver for zoo models on an in-memory dataset.
 
@@ -1302,6 +1303,20 @@ def train(
       after the checkpoint flush. ``chaos`` is the fault injector used
       by tests/test_resilience.py.
 
+    - ``elastic`` (a config.ElasticConfig): in-flight re-mesh + ZeRO-3
+      reshard (resilience/elastic.py). Requires the ZeRO-3 step
+      (``fused.zero=3``) — its world-size-independent full view is what
+      makes resharding possible. Before each optimizer step the loop
+      polls the ElasticController (preempt resize channel → chaos
+      ``resize@STEP:±K`` → planned schedule); on a trigger it quiesces,
+      reshards state for the surviving topology, rebuilds the jitted
+      step, and continues. Under ``scaling="global"`` (default) the
+      global batch and LR are held fixed — the loss trajectory tracks a
+      fixed-mesh run to reduction-order roundoff; ``"per-device"`` holds
+      the per-device batch fixed and scales the LR linearly, applied at
+      the next epoch boundary (the epoch's batch generator is fixed-size
+      mid-epoch).
+
     Returns (ZooState, list of per-epoch mean losses).
     """
     if loader not in ("device", "native"):
@@ -1349,6 +1364,13 @@ def train(
             )
     use_fused_update = fused is not None and fused.update
     use_zero3 = use_fused_update and fused.zero == 3
+    if elastic is not None and elastic.enabled and not use_zero3:
+        raise ValueError(
+            "elastic training requires the ZeRO-3 step (fused.zero=3 "
+            "with mesh + ring/hierarchical comm) — its world-size-"
+            "independent full view is what makes in-flight resharding "
+            "possible; enable it or drop --elastic"
+        )
     z3_plan = None
     z3_host = 1
     if use_zero3:
@@ -1469,15 +1491,16 @@ def train(
         if use_zero3:
             from parallel_cnn_tpu.train import checkpoint
 
-            world = z3_host * mesh.shape[DATA_AXIS]
-
             def saver(path, st, tstate):
                 # Ring files carry the world-size-independent full view,
                 # marked sharded so resume re-shards for the new mesh and
                 # plain restore/load_params refuse with the typed error.
+                # Reads z3_plan/z3_host from the enclosing scope at CALL
+                # time: after an elastic resize rebinds them, ring files
+                # carry the post-resize world (plan.shards == world).
                 checkpoint.save_sharded(
                     path, zero3_full_view(st, z3_plan, n_host=z3_host),
-                    tstate, world_size=world,
+                    tstate, world_size=z3_plan.shards,
                     bucket_bytes=comm.bucket_bytes,
                 )
 
@@ -1514,6 +1537,22 @@ def train(
             if verbose:
                 print(f"resumed from {path} (epoch {start_epoch})")
 
+    elastic_ctl = None
+    if elastic is not None and elastic.enabled:
+        from parallel_cnn_tpu.resilience.elastic import ElasticController
+
+        # Built AFTER ring creation and resume so the controller gets the
+        # ring for its snapshot fallback and a template from the state
+        # that will actually train (the view structure is world-size
+        # independent, so it never goes stale across resizes).
+        elastic_ctl = ElasticController(
+            elastic, world=z3_plan.shards, n_hosts=z3_host,
+            chaos=chaos, ring=ring, obs=obs,
+        )
+        elastic_ctl.register_template(
+            zero3_full_view(state, z3_plan, n_host=z3_host)
+        )
+
     n = images.shape[0]
     if loader == "native":
         import numpy as _np
@@ -1529,9 +1568,21 @@ def train(
         if controller is not None:
             controller.commit(state)
     epoch = start_epoch
+    # Monotone optimizer-step id across epochs (and rollback retries) —
+    # what elastic triggers (resize@STEP, schedule STEP:WORLD) reference.
+    opt_steps = start_epoch * steps
     _chaos_logged = False
     while epoch < epochs:
         t0 = time.perf_counter()
+        # Per-epoch batch geometry: fixed at (batch_size, steps) unless
+        # the elastic "per-device" policy rescales the global batch with
+        # the world — applied at epoch boundaries only (the epoch's batch
+        # generator is fixed-size mid-epoch).
+        if elastic_ctl is not None:
+            ebatch = min(elastic_ctl.global_batch_for(batch_size), n)
+            esteps = max(n // ebatch, 1)
+        else:
+            ebatch, esteps = batch_size, steps
         # Device-side loss accumulation: one host readback per epoch, so
         # step dispatch stays asynchronous (same discipline as
         # trainer.learn's single per-epoch float()). The opt-in per-step
@@ -1540,14 +1591,14 @@ def train(
         epoch_loss = jnp.float32(0.0)
         if loader == "native":
             batches = _native_epoch_batches(
-                np_images, np_labels, batch_size, steps, seed + epoch + 1
+                np_images, np_labels, ebatch, esteps, seed + epoch + 1
             )
         else:
             perm = jax.random.permutation(jax.random.key(seed + epoch), n)
             batches = (
-                (images[perm[i * batch_size : (i + 1) * batch_size]],
-                 labels[perm[i * batch_size : (i + 1) * batch_size]])
-                for i in range(steps)
+                (images[perm[i * ebatch : (i + 1) * ebatch]],
+                 labels[perm[i * ebatch : (i + 1) * ebatch]])
+                for i in range(esteps)
             )
         diverged = None
         batch_iter = enumerate(batches)
@@ -1557,8 +1608,35 @@ def train(
             if item is None:
                 break
             i, (bx, by) = item
+            if elastic_ctl is not None:
+                target = elastic_ctl.pending(opt_steps)
+                if target is not None:
+                    # Microbatch-boundary resize: reshard state for the
+                    # new topology and rebuild the jitted step (jit has
+                    # no baked-in in_shardings, so host batches and the
+                    # fresh state reshard onto the new mesh on entry).
+                    state, z3_plan, mesh, comm = elastic_ctl.resize(
+                        opt_steps, target, state=state, plan=z3_plan,
+                        comm=comm,
+                    )
+                    z3_host = elastic_ctl.n_hosts
+                    step = make_zero3_train_step(
+                        model, lr=elastic_ctl.lr_for(lr),
+                        momentum=momentum, accum_steps=accum_steps,
+                        mesh=mesh, augment=aug_fn, comm=comm,
+                        fused=fused, plan=z3_plan,
+                    )
+                    # Re-home the epoch accumulator: it is committed to
+                    # the pre-resize devices, and mixing meshes in one
+                    # add is an error. One host sync, inside the quiesce
+                    # the resize already paid for.
+                    epoch_loss = jnp.float32(float(epoch_loss))
             key = (
-                jax.random.fold_in(aug_base, epoch * steps + i)
+                jax.random.fold_in(
+                    aug_base,
+                    opt_steps if elastic_ctl is not None
+                    else epoch * steps + i,
+                )
                 if aug_fn is not None
                 else None
             )
@@ -1566,6 +1644,7 @@ def train(
                 state, loss = step(
                     state, jnp.asarray(bx), jnp.asarray(by), key
                 )
+            opt_steps += 1
             if chaos is not None:
                 state, loss = chaos.after_step(state, loss)
                 if obs.enabled and chaos.nan_fired and not _chaos_logged:
@@ -1595,7 +1674,7 @@ def train(
                     )
                     break
         with obs.span("zoo.readback", cat="step"):
-            mean_loss = float(epoch_loss) / max(steps, 1)
+            mean_loss = float(epoch_loss) / max(esteps, 1)
         if diverged is None and sentinel is not None:
             verdict = health_check(mean_loss, state)
             if not verdict.healthy:

@@ -22,9 +22,15 @@ tests/conftest.py), joins the coordination service, and runs:
 5. The same three steps under ZeRO-3 (make_zero3_train_step): resident
    param/momentum shards are distributed over both processes and the
    just-in-time head gathers cross the process boundary every step.
+6. An elastic resize ACROSS the process boundary: one ZeRO-3 step on the
+   full (2, 4) mesh, snapshot to the world-size-independent full view,
+   re-mesh to a (2, 2) survivor topology keeping two devices per
+   process, reshard with zero3_from_view, and finish the remaining
+   steps — asserting in-process that the 3-step loss trajectory matches
+   a fixed-mesh run (≤1e-5) and that the reshard itself is bit-exact.
 
-Prints parseable RESULT / TRAIN / TRAIN2D / TRAINHIER / TRAINZ3 lines
-for the parent to assert on.
+Prints parseable RESULT / TRAIN / TRAIN2D / TRAINHIER / TRAINZ3 /
+TRAINELASTIC lines for the parent to assert on.
 """
 
 import os
@@ -249,6 +255,129 @@ def train_trajectory_zero3():
     return losses
 
 
+def _tiny_model_nobn():
+    """BN-free twin of _tiny_model for the elastic parity leg: ring-comm
+    BatchNorm batch stats are per-shard (train/zoo.py documents this), so
+    only a stateless model can match a fixed-mesh trajectory across a
+    world-size change."""
+    from parallel_cnn_tpu.nn import core, layers
+
+    return core.Sequential([
+        layers.Conv2D(4, (3, 3)), layers.ReLU(),
+        layers.MaxPool(), layers.Flatten(), layers.Dense(10),
+    ])
+
+
+def train_trajectory_elastic():
+    """In-flight 8→4 elastic resize with the survivor world spanning BOTH
+    processes. Returns (max |Δloss| vs the fixed-mesh run, reshard
+    bit-exact as 0/1) — the parity math runs in-process because only this
+    worker can see the global arrays."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from parallel_cnn_tpu.config import CommConfig, FusedStepConfig
+    from parallel_cnn_tpu.parallel import mesh as mesh_lib
+    from parallel_cnn_tpu.train import zoo
+
+    # f32 activations: the parity mode (bf16 grads carry partition-
+    # dependent rounding ~1e-3 — tests/test_elastic.py pins the same).
+    comm = CommConfig(impl="hierarchical", bucket_bytes=2048)
+    fused = FusedStepConfig(update=True, tail=True, act_dtype="float32",
+                            zero=3)
+    model = _tiny_model_nobn()
+    xs, ys = _tiny_data()
+
+    def globalize_state(st, mesh):
+        rep = NamedSharding(mesh, P())
+        row = NamedSharding(
+            mesh, P((mesh_lib.HOST_AXIS, mesh_lib.DATA_AXIS))
+        )
+        return zoo.ZooState(
+            [_globalize(mesh, p, row) for p in st.params],
+            jax.tree_util.tree_map(
+                lambda a: _globalize(mesh, a, rep), st.model_state
+            ),
+            zoo.FusedOptState(
+                mom=[_globalize(mesh, m, row) for m in st.opt_state.mom],
+                scale=_globalize(mesh, st.opt_state.scale, rep),
+                good_steps=_globalize(mesh, st.opt_state.good_steps, rep),
+                skipped=_globalize(mesh, st.opt_state.skipped, rep),
+            ),
+        )
+
+    def run(mesh, st, plan, steps_range):
+        step = zoo.make_zero3_train_step(
+            model, lr=0.05, momentum=0.9, accum_steps=2, mesh=mesh,
+            augment=None, comm=comm, fused=fused, plan=plan,
+        )
+        dat = mesh_lib.batch_sharding(mesh)
+        out = []
+        for i in steps_range:
+            st, l = step(
+                st, _globalize(mesh, xs[i], dat),
+                _globalize(mesh, ys[i], dat),
+            )
+            out.append(float(l))
+        return st, out
+
+    mesh8 = mesh_lib.make_hier_mesh(n_hosts=2)  # (2, 4): the full fleet
+    st0, plan8 = zoo.init_zero3_state(
+        model, jax.random.key(7), TINY_SHAPE, n_data=4, fused=fused,
+        bucket_bytes=comm.bucket_bytes, n_host=2,
+    )
+
+    # Fixed-mesh baseline: all TRAIN_STEPS on the full (2, 4) mesh.
+    _, fixed = run(mesh8, globalize_state(st0, mesh8), plan8,
+                   range(TRAIN_STEPS))
+
+    # Elastic lap: one step at world 8, then lose half the fleet.
+    st8 = globalize_state(st0, mesh8)
+    st8, losses = run(mesh8, st8, plan8, range(1))
+
+    # Snapshot: the world-size-independent view, replicated inside one
+    # jit so every rank can read it (np.asarray needs full
+    # addressability; the raw row shards are half in the other process).
+    rep8 = NamedSharding(mesh8, P())
+    view = jax.jit(
+        lambda s: zoo.zero3_full_view(s, plan8, n_host=2),
+        out_shardings=rep8,
+    )(st8)
+    view_np = jax.tree_util.tree_map(np.asarray, view)
+
+    # Re-mesh: two survivors PER PROCESS — the host axis still crosses
+    # the process boundary, so the post-resize ring hops stay genuinely
+    # multi-process.
+    by_proc = {}
+    for d in jax.devices():
+        by_proc.setdefault(d.process_index, []).append(d)
+    surv = [
+        d
+        for p in sorted(by_proc)
+        for d in sorted(by_proc[p], key=lambda dd: dd.id)[:2]
+    ]
+    mesh4 = mesh_lib.make_elastic_mesh(4, n_hosts=2, devices=surv)
+    assert {d.process_index for d in mesh4.devices.flat} == {0, 1}
+
+    # Reshard on the host, prove bit-exactness, then globalize onto the
+    # survivor mesh and finish the lap at world 4.
+    st4_host, plan4 = zoo.zero3_from_view(
+        view_np, n_data=2, bucket_bytes=comm.bucket_bytes, n_host=2,
+    )
+    re_full = zoo.zero3_full_params(st4_host, plan4, n_host=2)
+    bitexact = int(all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(re_full),
+            jax.tree_util.tree_leaves(view_np["params"]),
+        )
+    ))
+    _, tail = run(mesh4, globalize_state(st4_host, mesh4), plan4,
+                  range(1, TRAIN_STEPS))
+    losses.extend(tail)
+    max_dloss = max(abs(a - b) for a, b in zip(fixed, losses))
+    return max_dloss, bitexact
+
+
 def main() -> int:
     joined = distributed.initialize()
     assert joined, "PCNN_* env must configure a 2-process run"
@@ -278,6 +407,9 @@ def main() -> int:
 
     z3 = train_trajectory_zero3()
     print("TRAINZ3", ",".join(f"{e:.8e}" for e in z3), flush=True)
+
+    max_dloss, bitexact = train_trajectory_elastic()
+    print(f"TRAINELASTIC {max_dloss:.8e} {bitexact}", flush=True)
     return 0
 
 

@@ -98,3 +98,9 @@ def pytest_configure(config):
         "thread-safety, journal conservation under chaos, exposition "
         "goldens, cross-host merge, config gating)",
     )
+    config.addinivalue_line(
+        "markers",
+        "elastic: elastic-runtime tests (resilience/elastic.py — "
+        "resize-lap loss parity, pure-reshard bit-exactness, chaos "
+        "resize triggers, partial-ring recovery, serve replica failover)",
+    )

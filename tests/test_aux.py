@@ -366,6 +366,21 @@ def test_two_process_distributed_smoke(tmp_path):
         ref_z3.append(float(l))
     np.testing.assert_allclose(z3, ref_z3, rtol=1e-5, atol=1e-6)
 
+    # Elastic resize ACROSS the process boundary (8 → 4 with two
+    # survivors per process): the worker computes fixed-vs-elastic loss
+    # parity and reshard bit-exactness in-process (only it can read the
+    # global arrays) and reports both; ranks must agree.
+    elastic_lines = []
+    for out in outs:
+        line = [l for l in out.splitlines()
+                if l.startswith("TRAINELASTIC")][0]
+        elastic_lines.append(line.split()[1:3])
+    assert elastic_lines[0] == elastic_lines[1], "elastic: ranks diverged"
+    max_dloss, bitexact = elastic_lines[0]
+    assert float(max_dloss) <= 1e-5, \
+        f"elastic resize broke loss parity: max dloss {max_dloss}"
+    assert bitexact == "1", "pure reshard was not bit-exact"
+
 
 def test_cli_zoo_profile_writes_trace(tmp_path):
     """Zoo --profile captures a jax.profiler trace of steady-state steps

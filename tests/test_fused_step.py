@@ -672,7 +672,7 @@ class TestShardedCheckpoint:
         )
         view, tstate, zmeta = checkpoint.restore_sharded(path, view8)
         assert tstate.epoch == 1
-        assert zmeta == {"world_size": 8, "bucket_bytes": 2048}
+        assert zmeta == {"world_size": 8, "bucket_bytes": 2048, "rank": 0}
         assert tree_bitequal(view8, view)
         # Re-shard the restored view for DIFFERENT world sizes and come
         # back: shard<->full is reshape/transpose/slice only, so every
