@@ -29,6 +29,14 @@
 #             (<= 1e-5) and pure-reshard bit-exactness. CPU-only and
 #             self-contained — gates commits like comm-multihost;
 #             ELASTIC_GATE is the contract line.
+#   serve-chaos
+#             SLO-guarded serving gate (benches/run.py --suite serve):
+#             seeded scenario suites (diurnal / flash-crowd /
+#             slow-client / chaos-kill clean, chaos-slow expected-trip)
+#             plus autoscaler flash-crowd recovery, judged on explicit
+#             p99 / shed-rate / conservation gates. CPU-only and
+#             self-contained — gates commits like comm-multihost;
+#             SERVE_SLO_GATE is the contract line.
 #
 # All artifacts append/write under docs/ with the given tag (default: the
 # UTC date), so repeated runs accumulate evidence instead of overwriting.
@@ -95,6 +103,23 @@ if [ "$MODE" = "elastic" ]; then
   RC=$?; echo "elastic rc=$RC" >> "$LOG"
   # The gate line is the contract: lap parity <= 1e-5 + bit-exact reshard.
   grep -q 'ELASTIC_GATE PASS' "$OUT" || RC=1
+  [ $RC -ne 0 ] && OVERALL=1
+  echo "=== playbook ${MODE} end rc=${OVERALL} $(date -u +%FT%TZ) ===" >> "$LOG"
+  exit $OVERALL
+fi
+
+if [ "$MODE" = "serve-chaos" ]; then
+  echo "--- serve SLO + chaos scenario gate ---" >> "$LOG"
+  OUT="docs/serve_slo_${TAG}.txt"
+  # 8 virtual devices so the 2-replica rows and the autoscaler's grown
+  # replica each get their own device slot.
+  timeout 900 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python benches/run.py --quick --suite serve > "$OUT" 2>&1
+  RC=$?; echo "serve-chaos rc=$RC" >> "$LOG"
+  # The gate line is the contract: clean scenarios pass their p99/shed
+  # gates AND the armed slow-replica run trips its gate (anti-vacuity).
+  grep -q 'SERVE_SLO_GATE PASS' "$OUT" || RC=1
   [ $RC -ne 0 ] && OVERALL=1
   echo "=== playbook ${MODE} end rc=${OVERALL} $(date -u +%FT%TZ) ===" >> "$LOG"
   exit $OVERALL

@@ -1040,6 +1040,164 @@ def bench_serve(quick: bool) -> List[Row]:
                 )
         finally:
             batcher.close()
+
+    rows.extend(_bench_serve_slo(quick))
+    return rows
+
+
+def _bench_serve_slo(quick: bool) -> List[Row]:
+    """The SLO scenario sweep behind the SERVE_SLO_GATE contract line.
+
+    Five seeded scenarios (serve/scenarios.py) against a lenet_ref
+    stack with admission control on, judged by their explicit p99 /
+    shed-rate / conservation gates:
+
+      clean legs    diurnal, flash-crowd, slow-client, chaos-kill must
+                    PASS their gates,
+      trip leg      chaos-slow arms slow-replica@3:400 against a 150 ms
+                    p99 gate — the leg passes iff the gate FAILS (the
+                    anti-vacuity proof that a tripped SLO is visible),
+      autoscaler    flash-crowd on a 1→2-replica pool under the control
+                    loop: unrecovered shed rate must land at 0 with at
+                    most one scale direction change (no flapping).
+
+    Every leg re-checks the conservation law server-side. Any violated
+    expectation appends an error row (rc 1) and flips the gate line to
+    SERVE_SLO_GATE FAIL — the serve-chaos playbook mode greps for it."""
+    del quick  # scenarios are fixed-duration; quick and full match
+    from parallel_cnn_tpu.config import ServeConfig
+    from parallel_cnn_tpu.resilience.chaos import ChaosMonkey
+    from parallel_cnn_tpu.serve import AutoScaler, get, scenarios, serve_stack
+
+    handle = get("lenet_ref")
+
+    def cfg(**kw):
+        base = dict(model="lenet_ref", max_batch=8, max_wait_ms=2.0,
+                    queue_depth=256, admission=True, slo_ms=200.0,
+                    window_s=2.0)
+        base.update(kw)
+        return ServeConfig(**base)
+
+    rows: List[Row] = []
+    failures: List[str] = []
+
+    def judge(leg: str, rep, want_pass: bool) -> None:
+        p99 = rep.p99_ms
+        rows.append(Row(
+            f"serve_slo_{leg}", round(p99, 2) if p99 is not None else -1.0,
+            "ms p99",
+            baseline_src=(
+                f"gate {rep.p99_gate_ms:.0f} ms, shed {rep.shed_rate:.3f} "
+                f"(gate {rep.shed_gate:.2f}), "
+                f"{'expected-trip' if not want_pass else 'clean'}, "
+                f"gates {rep.gates()}"
+            ),
+        ).finish())
+        if not rep.gates()["conservation"]:
+            failures.append(f"{leg}: conservation violated {rep.server}")
+        elif want_pass and not rep.passed:
+            failures.append(f"{leg}: gates {rep.gates()}")
+        elif not want_pass and rep.gates()["p99"]:
+            failures.append(
+                f"{leg}: p99 gate PASSED under an armed slow-replica "
+                "stall — the gate is vacuous"
+            )
+
+    # -- clean legs ------------------------------------------------------
+    pool, batcher = serve_stack(handle, cfg())
+    try:
+        judge("diurnal", scenarios.run("diurnal", batcher, seed=0), True)
+        judge("flash_crowd",
+              scenarios.run("flash-crowd", batcher, seed=1), True)
+        judge("slow_client",
+              scenarios.run("slow-client", batcher, seed=2), True)
+    finally:
+        batcher.close()
+
+    # -- chaos legs (fresh stacks: one-shot faults, clean counters) ------
+    n_rep = 2 if len(jax.devices()) >= 2 else 1
+    pool, batcher = serve_stack(
+        handle, cfg(n_replicas=n_rep, max_wait_ms=1.0),
+        chaos=ChaosMonkey.from_spec("kill-replica@5"),
+    )
+    try:
+        judge("chaos_kill", scenarios.run("chaos-kill", batcher, seed=3),
+              True)
+    finally:
+        batcher.close()
+
+    pool, batcher = serve_stack(
+        handle, cfg(max_wait_ms=1.0),
+        chaos=ChaosMonkey.from_spec("slow-replica@3:400"),
+    )
+    try:
+        judge("chaos_slow_trip",
+              scenarios.run("chaos-slow", batcher, seed=2), False)
+        if not batcher.chaos.slow_replica_fired:
+            failures.append("chaos_slow_trip: the stall never injected")
+    finally:
+        batcher.close()
+
+    # -- autoscaler recovery: flash-crowd must end with 0 unrecovered ----
+    # A CPU-fast stack absorbs the crowd without ever needing a second
+    # replica, which would leave the scale-up path untested — so a
+    # slow-replica stall is armed to push the windowed p99 over the SLO
+    # deterministically: the loop MUST scale up, and the crowd must
+    # still end with zero unrecovered demand and no flapping. The queue
+    # is deep enough to hold the whole crowd through the stall (and
+    # admission is off), so the backlog waits instead of shedding —
+    # recovery is the second replica draining it.
+    pool, batcher = serve_stack(
+        handle, cfg(window_s=1.0, admission=False, queue_depth=2048),
+        chaos=ChaosMonkey.from_spec("slow-replica@3:400"),
+    )
+    scaler = AutoScaler(pool, batcher, min_replicas=1, max_replicas=2,
+                        slo_ms=200.0, hysteresis=2, cooldown_s=1.0,
+                        interval_s=0.05)
+    try:
+        with scaler:
+            rep = scenarios.run("flash-crowd", batcher, seed=7)
+        flaps = scaler.direction_changes()
+        snap = scaler.snapshot()
+        rows.append(Row(
+            "serve_slo_autoscaler_flash_crowd",
+            round(rep.shed_rate, 4), "unrecovered shed rate",
+            baseline_src=(
+                f"scale_ups {snap['scale_ups']}, "
+                f"scale_downs {snap['scale_downs']}, "
+                f"direction changes {flaps} (<= 1), "
+                f"routable {snap['routable']}"
+            ),
+        ).finish())
+        if not rep.conservation_ok:
+            failures.append(f"autoscaler: conservation {rep.server}")
+        if rep.shed_rate != 0.0:
+            failures.append(
+                f"autoscaler: unrecovered shed rate {rep.shed_rate:.4f} "
+                "after flash-crowd (scale-up did not recover demand)"
+            )
+        if snap["scale_ups"] < 1:
+            failures.append(
+                "autoscaler: no scale-up despite the armed straggler "
+                "pushing windowed p99 over the SLO"
+            )
+        if flaps > 1:
+            failures.append(f"autoscaler: {flaps} direction changes (flap)")
+    finally:
+        batcher.close()
+
+    if failures:
+        rows.append(Row(
+            "error_serve_slo_gate", -1.0, "error",
+            baseline_src="; ".join(failures),
+        ))
+    print(
+        "SERVE_SLO_GATE "
+        + ("PASS: 4 clean scenario legs, chaos-slow trip proven, "
+           "autoscaler recovery flap-free"
+           if not failures else "FAIL: " + "; ".join(failures)),
+        flush=True,
+    )
     return rows
 
 

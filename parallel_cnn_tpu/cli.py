@@ -180,7 +180,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "checkpoint; resize@STEP:±K loses/adds K devices "
                         "at optimizer step STEP (needs --elastic); "
                         "kill-replica@SEQ kills the serving replica "
-                        "holding dispatch batch SEQ (serve path) "
+                        "holding dispatch batch SEQ (serve path); "
+                        "slow-replica@SEQ:MS stalls it MS ms instead "
                         "(resilience/chaos.py has the full grammar)")
     p.add_argument("--elastic", action="store_true",
                    help="elastic training (PCNN_ELASTIC): on a preemption "
@@ -399,6 +400,38 @@ def build_serve_parser(cmd: str) -> argparse.ArgumentParser:
     p.add_argument("--no-precompile", action="store_true",
                    help="compile buckets lazily on first use instead of at "
                         "startup [PCNN_SERVE_PRECOMPILE=0]")
+    p.add_argument("--admission", action="store_true",
+                   help="SLO admission control in front of the queue: "
+                        "EWMA reject-early shedding + the graceful-"
+                        "degradation ladder (serve/admission.py) "
+                        "[PCNN_SERVE_ADMISSION]")
+    p.add_argument("--slo-ms", type=float, default=sc.slo_ms,
+                   help="completion-time objective: admission budget for "
+                        "deadline-less requests, autoscaler p99 target, "
+                        "default scenario p99 gate [PCNN_SERVE_SLO_MS]")
+    p.add_argument("--autoscale", action="store_true",
+                   help="replica autoscaler: grow/drain the pool between "
+                        "--replicas and --max-replicas from windowed "
+                        "telemetry (serve/autoscaler.py) "
+                        "[PCNN_SERVE_AUTOSCALE]")
+    p.add_argument("--max-replicas", type=int, default=sc.max_replicas,
+                   help="autoscaler ceiling (0 = --replicas: no growth) "
+                        "[PCNN_SERVE_MAX_REPLICAS]")
+    p.add_argument("--window-s", type=float, default=sc.window_s,
+                   help="decay time constant of the windowed telemetry "
+                        "the autoscaler reads [PCNN_SERVE_WINDOW_S]")
+    p.add_argument("--scenario", default=None,
+                   choices=["diurnal", "flash-crowd", "slow-client",
+                            "chaos-kill", "chaos-slow"],
+                   help="drive a seeded SLO-gated traffic scenario "
+                        "(serve/scenarios.py) instead of plain loadgen; "
+                        "exit code reflects the p99/shed/conservation "
+                        "gates (chaos-* scenarios need --chaos)")
+    p.add_argument("--chaos", default=None, metavar="SPEC",
+                   help="serving fault injection: kill-replica@SEQ kills "
+                        "the replica holding dispatch batch SEQ, "
+                        "slow-replica@SEQ:MS stalls it MS ms "
+                        "(resilience/chaos.py)")
     p.add_argument("--requests", type=int,
                    default=64 if cmd == "serve" else 512,
                    help="traffic volume to drive through the stack")
@@ -419,6 +452,7 @@ def build_serve_parser(cmd: str) -> argparse.ArgumentParser:
 
 
 def _serve_config_from_args(args: argparse.Namespace) -> ServeConfig:
+    env = ServeConfig.from_env()
     return ServeConfig(
         model=args.model,
         checkpoint=args.checkpoint,
@@ -429,6 +463,11 @@ def _serve_config_from_args(args: argparse.Namespace) -> ServeConfig:
         deadline_ms=args.deadline_ms,
         conv_backend=args.conv_backend,
         precompile=not args.no_precompile,
+        admission=args.admission or env.admission,
+        slo_ms=args.slo_ms,
+        autoscale=args.autoscale or env.autoscale,
+        max_replicas=args.max_replicas,
+        window_s=args.window_s,
     )
 
 
@@ -461,21 +500,51 @@ def _run_serve(cmd: str, argv: List[str]) -> int:
 
     import numpy as np
 
-    from parallel_cnn_tpu.serve import get, loadgen, serve_stack
+    from parallel_cnn_tpu.serve import (
+        AutoScaler,
+        get,
+        loadgen,
+        scenarios,
+        serve_stack,
+    )
 
     handle = get(cfg.model, conv_backend=cfg.conv_backend)
     obs_bundle = obs_lib.from_config(_obs_config_from_args(args), run=cmd)
+    chaos = None
+    if args.chaos:
+        from parallel_cnn_tpu.resilience.chaos import ChaosMonkey
+
+        chaos = ChaosMonkey.from_spec(args.chaos)
     t0 = time.perf_counter()
-    pool, batcher = serve_stack(handle, cfg, obs=obs_bundle)
+    pool, batcher = serve_stack(handle, cfg, obs=obs_bundle, chaos=chaos)
     startup = time.perf_counter() - t0
     if obs_bundle.enabled:
         # Exposition parity: the ServeStats counters feed the registry's
         # Prometheus/JSON snapshots without changing their semantics.
         batcher.stats.attach_registry(obs_bundle.registry)
+        if batcher.admission is not None:
+            batcher.admission.attach_registry(obs_bundle.registry)
     src = cfg.checkpoint or "fresh init (no --checkpoint)"
     print(f"[serve] model={cfg.model} params from {src}")
     print(f"[serve] replicas={cfg.n_replicas} on "
           f"{[str(e.device) for e in pool.engines]}")
+    if cfg.admission:
+        print(f"[serve] admission control on (SLO {cfg.slo_ms:g} ms)")
+    scaler = None
+    if cfg.autoscale:
+        scaler = AutoScaler(
+            pool, batcher,
+            min_replicas=1,
+            max_replicas=cfg.effective_max_replicas,
+            slo_ms=cfg.slo_ms,
+            obs=obs_bundle,
+        )
+        if obs_bundle.enabled:
+            scaler.attach_registry(obs_bundle.registry)
+        scaler.start()
+        print(f"[serve] autoscaler on "
+              f"(1..{cfg.effective_max_replicas} replicas, "
+              f"p99 target {cfg.slo_ms:g} ms)")
     if cfg.precompile:
         buckets = pool.engines[0].stats.compile_seconds
         table = ", ".join(f"b{b}: {s * 1e3:.0f} ms"
@@ -504,34 +573,66 @@ def _run_serve(cmd: str, argv: List[str]) -> int:
             )
             print(f"[serve] padded-bucket parity (n={n}→b{b}): {parity}")
 
-        report = loadgen.run(
-            batcher,
-            pattern=args.pattern,
-            n_requests=args.requests,
-            concurrency=args.concurrency,
-            rate=args.rate,
-            deadline_ms=args.deadline_ms or None,
-            seed=args.seed,
-        )
-        print(f"[{cmd}] {args.pattern}-loop: "
-              f"{report.completed}/{report.requests} ok, "
-              f"{report.throughput:.1f} req/s, "
-              f"shed rate {report.shed_rate:.3f}")
-        lat = report.latency.summary(scale=1e3)
-        if lat.get("count"):
-            print(f"[{cmd}] latency p50 {lat['p50']:.2f} ms, "
-                  f"p90 {lat['p90']:.2f} ms, p99 {lat['p99']:.2f} ms")
+        rc = 0
+        if args.scenario:
+            report = scenarios.run(
+                args.scenario, batcher,
+                seed=args.seed,
+                deadline_ms=args.deadline_ms or None,
+            )
+            gates = report.gates()
+            verdict = "PASS" if report.passed else "FAIL"
+            p99 = report.p99_ms
+            print(f"[{cmd}] scenario {report.name}: "
+                  f"{report.completed}/{report.requests} ok, "
+                  f"shed rate {report.shed_rate:.3f}, "
+                  f"p99 {p99:.1f} ms" if p99 is not None else
+                  f"[{cmd}] scenario {report.name}: no completions")
+            print(f"[{cmd}] gates {verdict}: " + ", ".join(
+                f"{k}={'ok' if v else 'TRIPPED'}"
+                for k, v in gates.items()
+            ))
+            rc = 0 if report.passed else 1
+        else:
+            report = loadgen.run(
+                batcher,
+                pattern=args.pattern,
+                n_requests=args.requests,
+                concurrency=args.concurrency,
+                rate=args.rate,
+                deadline_ms=args.deadline_ms or None,
+                seed=args.seed,
+            )
+            print(f"[{cmd}] {args.pattern}-loop: "
+                  f"{report.completed}/{report.requests} ok, "
+                  f"{report.throughput:.1f} req/s, "
+                  f"shed rate {report.shed_rate:.3f}")
+            lat = report.latency.summary(scale=1e3)
+            if lat.get("count"):
+                print(f"[{cmd}] latency p50 {lat['p50']:.2f} ms, "
+                      f"p90 {lat['p90']:.2f} ms, p99 {lat['p99']:.2f} ms")
+        if scaler is not None:
+            scaler.close()
+            snap = scaler.snapshot()
+            print(f"[{cmd}] autoscaler: {snap['scale_ups']} up, "
+                  f"{snap['scale_downs']} down, "
+                  f"{snap['routable']} replicas routable")
         print(batcher.stats.render())
         if args.json:
             out = {"config": dataclasses.asdict(cfg),
                    "report": report.to_dict(),
-                   "telemetry": batcher.stats.snapshot()}
+                   "telemetry": batcher.stats.snapshot(),
+                   "window": batcher.stats.window_snapshot()}
+            if batcher.admission is not None:
+                out["admission"] = batcher.admission.snapshot()
+            if scaler is not None:
+                out["autoscaler"] = scaler.snapshot()
             with open(args.json, "w") as f:
                 json_mod.dump(out, f, indent=2)
             print(f"[{cmd}] report written to {args.json}")
     for kind, path in obs_bundle.finish().items():
         print(f"[{cmd}] {kind} written to {path}")
-    return 0
+    return rc
 
 
 def _run_check(argv: List[str]) -> int:

@@ -353,6 +353,23 @@ class ServeConfig:
     # AOT-compile every bucket at startup so steady-state requests never
     # trigger a trace; False compiles lazily on first use per bucket.
     precompile: bool = True
+    # SLO admission control (serve/admission.py): EWMA reject-early
+    # shedding + the graceful-degradation ladder in front of the queue.
+    # Off by default — the historical admit-until-full behavior.
+    admission: bool = False
+    # Completion-time objective (ms): the admission predictor's budget
+    # for deadline-less requests, the autoscaler's p99 target, and the
+    # default scenario p99 gate.
+    slo_ms: float = 100.0
+    # Replica autoscaler (serve/autoscaler.py): grow/drain the pool from
+    # windowed telemetry between n_replicas and max_replicas.
+    autoscale: bool = False
+    # Autoscaler ceiling; 0 = n_replicas (growth disabled even with
+    # autoscale on — scale-down/scale-back-up only).
+    max_replicas: int = 0
+    # Exponential-decay time constant (seconds) of the windowed
+    # telemetry views the autoscaler reads (serve/telemetry.py).
+    window_s: float = 10.0
 
     def __post_init__(self):
         if self.max_batch < 1 or (self.max_batch & (self.max_batch - 1)):
@@ -367,6 +384,24 @@ class ServeConfig:
             raise ValueError(f"n_replicas must be >= 1, got {self.n_replicas}")
         if self.conv_backend not in ("xla", "pallas"):
             raise ValueError(f"unknown conv backend {self.conv_backend!r}")
+        if self.slo_ms <= 0:
+            raise ValueError(f"slo_ms must be > 0, got {self.slo_ms}")
+        if self.window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {self.window_s}")
+        if self.max_replicas < 0:
+            raise ValueError(
+                f"max_replicas must be >= 0, got {self.max_replicas}"
+            )
+        if self.max_replicas and self.max_replicas < self.n_replicas:
+            raise ValueError(
+                f"max_replicas ({self.max_replicas}) must be >= "
+                f"n_replicas ({self.n_replicas})"
+            )
+
+    @property
+    def effective_max_replicas(self) -> int:
+        """The autoscaler ceiling: max_replicas, or n_replicas when 0."""
+        return self.max_replicas or self.n_replicas
 
     @staticmethod
     def from_env() -> "ServeConfig":
@@ -386,6 +421,11 @@ class ServeConfig:
             deadline_ms=float(e("PCNN_SERVE_DEADLINE_MS", "0")),
             conv_backend=e("PCNN_SERVE_CONV_BACKEND", "xla"),
             precompile=e("PCNN_SERVE_PRECOMPILE", "1") != "0",
+            admission=e("PCNN_SERVE_ADMISSION", "0") != "0",
+            slo_ms=float(e("PCNN_SERVE_SLO_MS", "100")),
+            autoscale=e("PCNN_SERVE_AUTOSCALE", "0") != "0",
+            max_replicas=int(e("PCNN_SERVE_MAX_REPLICAS", "0")),
+            window_s=float(e("PCNN_SERVE_WINDOW_S", "10")),
         )
 
 

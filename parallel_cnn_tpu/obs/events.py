@@ -19,6 +19,10 @@ failover path's ``replica_evicted`` / ``failover`` /
 obey the same conservation law as ``ServeStats``: submitted ==
 completed + shed + expired + failed (and must keep obeying it across a
 mid-traffic replica death: failover re-resolves, never duplicates).
+The SLO-guarded serving layer adds ``admission_level`` (degradation-
+ladder transitions, serve/admission.py), ``scale_up`` / ``scale_down``
+(autoscaler decisions, serve/autoscaler.py), and ``chaos_slow_replica``
+(straggler injection, the slow-replica twin of the chaos kill).
 """
 
 from __future__ import annotations

@@ -32,10 +32,16 @@ tests/test_resilience.py drives training through it end-to-end. Faults:
   batch N dies (serve.ReplicaDead) — the ReplicaPool failover path:
   evict, retry the in-flight batch on a survivor, re-pin a replacement.
   One-shot.
+- **Replica straggler at batch N** (``slow_replica=(N, MS)``, spec
+  ``slow-replica@N:MS``): the serving replica about to execute
+  dispatched batch N stalls for MS milliseconds before its predict —
+  the tail-latency fault the serving SLO gate exists to catch (and the
+  harness for training straggler ablations later). One-shot, journaled
+  by the batcher like ``kill-replica@``.
 
 The full CLI spec grammar (documented here, consumed by ``from_spec``):
 ``nan@STEP`` | ``kill@EPOCH`` | ``kill9@EPOCH`` | ``resize@STEP:±K`` |
-``kill-replica@SEQ``.
+``kill-replica@SEQ`` | ``slow-replica@SEQ:MS``.
 
 No wall clocks, no unseeded randomness — a chaos run replays exactly.
 """
@@ -81,6 +87,7 @@ class ChaosMonkey:
         kill_signal: int = signal.SIGTERM,
         resize_delta: Optional[Tuple[int, int]] = None,
         kill_replica_seq: Optional[int] = None,
+        slow_replica: Optional[Tuple[int, float]] = None,
     ):
         self.nan_step = nan_step
         self.kill_epoch = kill_epoch
@@ -91,11 +98,15 @@ class ChaosMonkey:
         # Dispatched-batch sequence number at which the executing serve
         # replica dies (serve/batcher.py polls kill_replica_at).
         self.kill_replica_seq = kill_replica_seq
+        # (seq, ms): the replica executing dispatched batch `seq` stalls
+        # for `ms` milliseconds (serve/batcher.py polls slow_replica_at).
+        self.slow_replica = slow_replica
         self.steps_seen = 0
         self.nan_fired = False
         self.kill_fired = False
         self.resize_fired = False
         self.kill_replica_fired = False
+        self.slow_replica_fired = False
 
     def after_step(self, tree: Any, loss: Any) -> Tuple[Any, Any]:
         """Post-step hook: returns (possibly poisoned) (tree, loss)."""
@@ -144,19 +155,49 @@ class ChaosMonkey:
             return True
         return False
 
+    def slow_replica_at(self, seq: int) -> Optional[float]:
+        """Dispatch hook (serve batcher): the straggler stall in
+        milliseconds, exactly once, for the replica about to execute
+        dispatched batch ``seq``; None otherwise."""
+        if (
+            self.slow_replica is not None
+            and not self.slow_replica_fired
+            and seq >= self.slow_replica[0]
+        ):
+            self.slow_replica_fired = True
+            return self.slow_replica[1]
+        return None
+
     @classmethod
     def from_spec(cls, spec: str) -> "ChaosMonkey":
         """Parse a CLI fault spec (full grammar in the module docstring):
         ``nan@STEP``, ``kill@EPOCH`` (SIGTERM), ``kill9@EPOCH`` (SIGKILL),
-        ``resize@STEP:±K`` (elastic world-size delta at step STEP), or
+        ``resize@STEP:±K`` (elastic world-size delta at step STEP),
         ``kill-replica@SEQ`` (serve replica death at dispatched batch
-        SEQ)."""
+        SEQ), or ``slow-replica@SEQ:MS`` (serve replica stalls MS ms at
+        dispatched batch SEQ)."""
         kind, sep, arg = spec.partition("@")
         if not sep or not arg:
             raise ValueError(
                 f"bad chaos spec {spec!r}; expected nan@STEP, kill@EPOCH, "
-                "kill9@EPOCH, resize@STEP:±K or kill-replica@SEQ"
+                "kill9@EPOCH, resize@STEP:±K, kill-replica@SEQ or "
+                "slow-replica@SEQ:MS"
             )
+        if kind == "slow-replica":
+            seq, ssep, ms = arg.partition(":")
+            try:
+                if not ssep:
+                    raise ValueError(arg)
+                delay = float(ms)
+                if delay <= 0:
+                    raise ValueError(arg)
+                return cls(slow_replica=(int(seq), delay))
+            except ValueError:
+                raise ValueError(
+                    f"bad chaos spec {spec!r}; slow-replica wants "
+                    "slow-replica@SEQ:MS with positive MS "
+                    "(e.g. slow-replica@2:250)"
+                ) from None
         if kind == "resize":
             step, ssep, delta = arg.partition(":")
             try:
@@ -174,7 +215,8 @@ class ChaosMonkey:
         if not arg.isdigit():
             raise ValueError(
                 f"bad chaos spec {spec!r}; expected nan@STEP, kill@EPOCH, "
-                "kill9@EPOCH, resize@STEP:±K or kill-replica@SEQ"
+                "kill9@EPOCH, resize@STEP:±K, kill-replica@SEQ or "
+                "slow-replica@SEQ:MS"
             )
         n = int(arg)
         if kind == "nan":

@@ -8,10 +8,19 @@ north star; docs/serving.md for the design).
                device-pinned replicas (Engine / ReplicaPool)
     batcher    bounded queue + deadline-aware dynamic batching with
                typed Overloaded backpressure (DynamicBatcher)
-    telemetry  latency percentiles, queue depth, occupancy, shed rate
+    telemetry  latency percentiles, queue depth, occupancy, shed rate —
+               lifetime and windowed (decayed) views
+    admission  SLO admission control: EWMA reject-early shedding +
+               the graceful-degradation ladder (AdmissionController)
+    autoscaler hysteresis/cooldown control loop growing/draining the
+               ReplicaPool from windowed telemetry (AutoScaler)
+    scenarios  seeded traffic scenarios with explicit p99/shed gates
+               (diurnal, flash-crowd, slow-client, chaos-kill/slow)
     loadgen    seeded closed-/open-loop traffic + client retry protocol
 """
 
+from parallel_cnn_tpu.serve.admission import AdmissionController  # noqa: F401
+from parallel_cnn_tpu.serve.autoscaler import AutoScaler  # noqa: F401
 from parallel_cnn_tpu.serve.batcher import (  # noqa: F401
     DeadlineExceeded,
     DynamicBatcher,
@@ -27,4 +36,8 @@ from parallel_cnn_tpu.serve.engine import (  # noqa: F401
     load_or_init,
 )
 from parallel_cnn_tpu.serve.registry import ModelHandle, available, get  # noqa: F401
+from parallel_cnn_tpu.serve.scenarios import (  # noqa: F401
+    SCENARIOS,
+    ScenarioReport,
+)
 from parallel_cnn_tpu.serve.telemetry import ServeStats  # noqa: F401

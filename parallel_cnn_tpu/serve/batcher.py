@@ -12,9 +12,17 @@ Queueing model (docs/serving.md has the math):
   whichever first. max_wait_ms is therefore the batching latency tax an
   idle-period request pays, and the knob that trades p50 latency for
   batch occupancy at load.
-- Requests carry optional deadlines; ones already past their deadline at
-  dispatch time are dropped with ``DeadlineExceeded`` instead of wasting
-  a device slot on an answer nobody is waiting for.
+- Requests carry optional deadlines; overdue ones are dropped with
+  ``DeadlineExceeded`` the moment the worker pops them (the coalesce-time
+  sweep — under backlog an expired request frees its queue slot
+  immediately instead of riding along to dispatch), with a second sweep
+  at dispatch time as the final check before a device slot is spent.
+- An optional admission controller (serve/admission.py) runs in front of
+  the queue: ``submit`` consults it before enqueueing (predicted-late and
+  degradation-ladder rejects surface as ``Overloaded`` and count as
+  sheds), and the worker lets it shrink the coalescing window / cap the
+  bucket under pressure. The batcher feeds queue-wait and service-time
+  observations back so the controller's EWMA predictor tracks reality.
 - The dispatched batch pads into the engine's power-of-two bucket and
   the result rows are split back per request. Dispatch goes through a
   pool of ``n_replicas`` runner threads, so while replica 0 computes,
@@ -59,6 +67,9 @@ class Future:
         # dispatch; None if the request died before reaching a device).
         self.replica: Optional[int] = None
         self.batch_seq: Optional[int] = None
+        # Resolution instant (monotonic), so callers polling result()
+        # later can still measure true latency instead of observe time.
+        self.t_done: Optional[float] = None
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -72,20 +83,23 @@ class Future:
 
     def _resolve(self, value: np.ndarray) -> None:
         self._value = value
+        self.t_done = time.monotonic()
         self._event.set()
 
     def _fail(self, err: BaseException) -> None:
         self._error = err
+        self.t_done = time.monotonic()
         self._event.set()
 
 
 class _Request:
-    __slots__ = ("x", "deadline", "t_submit", "future")
+    __slots__ = ("x", "deadline", "t_submit", "priority", "future")
 
-    def __init__(self, x, deadline, t_submit):
+    def __init__(self, x, deadline, t_submit, priority="guaranteed"):
         self.x = x
         self.deadline = deadline  # absolute monotonic seconds, or None
         self.t_submit = t_submit
+        self.priority = priority  # "guaranteed" | "best-effort"
         self.future = Future()
 
 
@@ -110,13 +124,18 @@ class DynamicBatcher:
         start: bool = True,
         obs: Optional["obs_lib.Obs"] = None,
         chaos=None,
+        admission=None,
     ):
         self.pool = pool
         # Fault injector (resilience.chaos.ChaosMonkey): kill_replica_at
         # fires on the dispatch batch sequence number, killing the target
         # replica the instant before its predict — the mid-traffic death
-        # the failover path exists for.
+        # the failover path exists for; slow_replica_at stalls it instead
+        # (the straggler the SLO gate exists to catch).
         self.chaos = chaos
+        # SLO admission controller (serve/admission.py), or None for the
+        # historical admit-everything-until-the-queue-is-full behavior.
+        self.admission = admission
         self.max_batch = pool.max_batch
         self.max_wait_s = max_wait_ms / 1e3
         self.default_deadline_s = deadline_ms / 1e3 if deadline_ms else None
@@ -129,6 +148,11 @@ class DynamicBatcher:
         )
         self._stop = threading.Event()
         self._batch_seq = 0
+        # Per-replica in-flight batch counts (formed-but-unfinished):
+        # the autoscaler's drain barrier — a replica retires only after
+        # its count returns to zero. Guarded by _lock.
+        self._lock = threading.Lock()
+        self._inflight: dict = {}
         self._runners = [
             threading.Thread(
                 target=self._runner_loop, name=f"serve-runner-{i}", daemon=True
@@ -152,12 +176,24 @@ class DynamicBatcher:
 
     # -- client surface -------------------------------------------------
 
-    def submit(self, x, deadline_ms: Optional[float] = None) -> Future:
+    def submit(self, x, deadline_ms: Optional[float] = None,
+               priority: str = "guaranteed") -> Future:
         """Enqueue one request (a single sample, shape == in_shape).
 
-        Raises Overloaded immediately when the bounded queue is full.
+        Raises Overloaded immediately when the bounded queue is full —
+        or, with an admission controller attached, when the controller
+        predicts the deadline cannot be met / the degradation ladder is
+        shedding this priority class (both count as sheds: conservation
+        is submitted == completed + shed + expired + failed).
         ``deadline_ms`` is a per-request budget from now (overrides the
-        batcher default; None keeps the default, 0 disables)."""
+        batcher default; None keeps the default, 0 disables).
+        ``priority`` is "guaranteed" (default) or "best-effort" — the
+        class the ladder drops first under pressure."""
+        if priority not in ("guaranteed", "best-effort"):
+            raise ValueError(
+                f"priority must be 'guaranteed' or 'best-effort', "
+                f"got {priority!r}"
+            )
         x = np.asarray(x, dtype=np.float32)
         if x.shape != tuple(self.pool.handle.in_shape):
             raise ValueError(
@@ -173,11 +209,24 @@ class DynamicBatcher:
             )
         else:
             deadline = now + deadline_ms / 1e3 if deadline_ms else None
-        req = _Request(x, deadline, now)
+        req = _Request(x, deadline, now, priority)
         self.stats.on_submit()
         if self.obs.enabled:
             self.obs.event("submit", req=id(req.future))
             self.obs.tracer.begin_async("request", id(req.future))
+        if self.admission is not None:
+            reason = self.admission.admit(
+                priority=priority, deadline=deadline, now=now,
+                queue_depth=self._queue.qsize(),
+            )
+            if reason is not None:
+                self.stats.on_shed()
+                if self.obs.enabled:
+                    self.obs.event("shed", req=id(req.future),
+                                   reason=reason)
+                    self.obs.tracer.end_async("request", id(req.future))
+                raise Overloaded(f"admission rejected: {reason}; "
+                                 "back off and retry")
         try:
             self._queue.put_nowait(req)
         except queue_mod.Full:
@@ -226,40 +275,68 @@ class DynamicBatcher:
 
     # -- worker side ----------------------------------------------------
 
+    def _expire_req(self, r: _Request, now: float, where: str) -> None:
+        """Fail one overdue request (coalesce- or dispatch-time sweep);
+        the caller already knows now > r.deadline."""
+        r.future._fail(DeadlineExceeded(
+            f"deadline passed {1e3 * (now - r.deadline):.1f} ms "
+            f"{where}"
+        ))
+        self.stats.on_expired(1)
+        if self.obs.enabled:
+            self.obs.event("expired", req=id(r.future))
+            self.obs.tracer.end_async("request", id(r.future))
+
+    def _pop_live(self, timeout: float) -> Optional[_Request]:
+        """Pop one request, expiring overdue ones immediately (the
+        coalesce-time sweep): under backlog a dead request frees its
+        queue slot the moment the worker sees it, instead of riding
+        along to dispatch. Returns None on timeout."""
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            try:
+                r = self._queue.get(timeout=max(remaining, 0.0))
+            except queue_mod.Empty:
+                return None
+            now = time.monotonic()
+            if r.deadline is not None and now > r.deadline:
+                self._expire_req(r, now, "in queue (coalesce sweep)")
+                continue
+            return r
+
     def _worker_loop(self) -> None:
         while not self._stop.is_set():
-            try:
-                first = self._queue.get(timeout=0.05)
-            except queue_mod.Empty:
+            first = self._pop_live(timeout=0.05)
+            if first is None:
                 continue
+            # The degradation ladder (admission controller) may shrink
+            # the coalescing window and cap the bucket under pressure.
+            wait_s = self.max_wait_s
+            cap = self.max_batch
+            if self.admission is not None:
+                wait_s = self.admission.effective_wait_s(wait_s)
+                cap = self.admission.effective_max_batch(cap)
             batch = [first]
             t0 = time.monotonic()
             with self.obs.span("serve.coalesce", cat="serve"):
-                while len(batch) < self.max_batch:
-                    remaining = t0 + self.max_wait_s - time.monotonic()
+                while len(batch) < cap:
+                    remaining = t0 + wait_s - time.monotonic()
                     if remaining <= 0:
                         break
-                    try:
-                        batch.append(self._queue.get(timeout=remaining))
-                    except queue_mod.Empty:
+                    r = self._pop_live(timeout=remaining)
+                    if r is None:
                         break
+                    batch.append(r)
             now = time.monotonic()
             live: List[_Request] = []
             n_expired = 0
             for r in batch:
                 if r.deadline is not None and now > r.deadline:
-                    r.future._fail(DeadlineExceeded(
-                        f"deadline passed {1e3 * (now - r.deadline):.1f} ms "
-                        "before dispatch"
-                    ))
+                    self._expire_req(r, now, "before dispatch")
                     n_expired += 1
-                    if self.obs.enabled:
-                        self.obs.event("expired", req=id(r.future))
-                        self.obs.tracer.end_async("request", id(r.future))
                 else:
                     live.append(r)
-            if n_expired:
-                self.stats.on_expired(n_expired)
             if not live:
                 continue
             replica = self.pool.next_replica()
@@ -273,19 +350,33 @@ class DynamicBatcher:
                 replica=replica,
                 queue_depth=self._queue.qsize(),
             )
+            if self.admission is not None:
+                self.admission.observe_queue_wait(
+                    max(now - r.t_submit for r in live)
+                )
             if self.obs.enabled:
                 self.obs.event(
                     "batch", seq=seq, n=len(live), bucket=bucket,
                     replica=replica, expired=n_expired,
                 )
+            with self._lock:
+                self._inflight[replica] = self._inflight.get(replica, 0) + 1
             # Blocks when all runners are busy — deliberate backpressure
             # (see _dispatch's bound). Bail out on close.
+            queued = False
             while not self._stop.is_set():
                 try:
                     self._dispatch.put((live, replica, seq), timeout=0.05)
+                    queued = True
                     break
                 except queue_mod.Full:
                     continue
+            if not queued:
+                # Closing: the batch never reached a runner; close()
+                # fails its futures, but the in-flight count must not
+                # leak a phantom batch.
+                with self._lock:
+                    self._inflight[replica] -= 1
 
     def _runner_loop(self) -> None:
         while not self._stop.is_set():
@@ -293,9 +384,58 @@ class DynamicBatcher:
                 live, replica, seq = self._dispatch.get(timeout=0.05)
             except queue_mod.Empty:
                 continue
-            self._run_batch(live, replica, seq)
+            try:
+                self._run_batch(live, replica, seq)
+            finally:
+                with self._lock:
+                    self._inflight[replica] -= 1
+
+    def inflight(self, replica: int) -> int:
+        """Batches formed for ``replica`` and not yet finished — the
+        autoscaler's drain barrier (failover retries still count against
+        the ORIGINAL replica until the batch resolves, which is the
+        conservative direction for a drain)."""
+        with self._lock:
+            return self._inflight.get(replica, 0)
+
+    @property
+    def n_runners(self) -> int:
+        with self._lock:
+            return len(self._runners)
+
+    def add_runner(self) -> None:
+        """Grow the runner pool by one thread (autoscaler scale-up, after
+        ReplicaPool.grow appended a replica): widens the dispatch bound
+        so the new replica can hold a batch in flight concurrently."""
+        with self._lock:
+            i = len(self._runners)
+            t = threading.Thread(
+                target=self._runner_loop, name=f"serve-runner-{i}",
+                daemon=True,
+            )
+            self._runners.append(t)
+            if self._started:
+                t.start()
+        # queue.Queue has no resize API; maxsize is guarded by the
+        # queue's OWN mutex (the one put()/get() contend on), not by
+        # self._lock — taking both here would order them against the
+        # worker loop, which blocks in put() while holding no lock.
+        with self._dispatch.mutex:
+            # graftcheck: disable=lock-discipline -- maxsize belongs to the queue's own mutex, held by this with-block
+            self._dispatch.maxsize += 1
+            self._dispatch.not_full.notify()
 
     def _run_batch(self, live: List[_Request], replica: int, seq: int) -> None:
+        if self.chaos is not None:
+            stall_ms = self.chaos.slow_replica_at(seq)
+            if stall_ms is not None:
+                # Chaos: the replica straggles — the batch (and the
+                # queue behind it) eats the stall, exactly the tail
+                # latency the SLO gate watches.
+                if self.obs.enabled:
+                    self.obs.event("chaos_slow_replica", seq=seq,
+                                   replica=replica, ms=stall_ms)
+                time.sleep(stall_ms / 1e3)
         if self.chaos is not None and self.chaos.kill_replica_at(seq):
             # Chaos: the replica dies the instant before its predict —
             # the dispatch already committed to it, so the failure is
@@ -320,8 +460,14 @@ class DynamicBatcher:
         propagates to the caller BEFORE any future resolves (the predict
         raises up front), so a retried batch is still whole."""
         xs = np.stack([r.x for r in live])
+        t_exec = time.monotonic()
         ys, _ = self.pool.predict(xs, replica=replica)
         done = time.monotonic()
+        if self.admission is not None:
+            self.admission.observe_service(
+                self.pool.engines[replica].bucket_for(len(live)),
+                done - t_exec,
+            )
         for i, r in enumerate(live):
             r.future.replica = replica
             r.future.batch_seq = seq
@@ -418,10 +564,14 @@ def serve_stack(
     start: bool = True,
     obs: Optional["obs_lib.Obs"] = None,
     chaos=None,
+    admission=None,
 ):
     """(pool, batcher) wired from a config.ServeConfig — the one-call
     constructor the CLI, benches, and dryrun share. ``chaos`` (a
-    resilience.chaos.ChaosMonkey) arms kill-replica fault injection."""
+    resilience.chaos.ChaosMonkey) arms kill-replica / slow-replica fault
+    injection. ``admission`` overrides the controller instance; by
+    default one is built when ``cfg.admission`` is set (the SLO surface
+    — serve/admission.py)."""
     from parallel_cnn_tpu.serve.engine import ReplicaPool
 
     pool = ReplicaPool(
@@ -433,6 +583,16 @@ def serve_stack(
         precompile=cfg.precompile,
         obs=obs,
     )
+    if admission is None and getattr(cfg, "admission", False):
+        from parallel_cnn_tpu.serve.admission import AdmissionController
+
+        admission = AdmissionController(
+            slo_ms=cfg.slo_ms,
+            queue_depth=cfg.queue_depth,
+            obs=obs,
+        )
+    if stats is None:
+        stats = ServeStats(window_s=getattr(cfg, "window_s", 10.0))
     batcher = DynamicBatcher(
         pool,
         max_wait_ms=cfg.max_wait_ms,
@@ -442,5 +602,6 @@ def serve_stack(
         start=start,
         obs=obs,
         chaos=chaos,
+        admission=admission,
     )
     return pool, batcher

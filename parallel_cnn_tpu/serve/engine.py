@@ -263,6 +263,13 @@ class ReplicaPool:
     exactly this purpose. The batcher's failover path drives the
     evict → retry-on-survivor → respawn sequence (chaos
     ``kill-replica@SEQ`` is the test harness for it).
+
+    Elastic (serve/autoscaler.py drives these): ``grow`` adds serving
+    capacity — it revives a dead slot via the respawn path when one
+    exists, else appends a fresh pinned Engine; ``drain`` makes a
+    replica unroutable while leaving it alive so in-flight batches
+    complete; ``retire`` then frees the drained slot (a later ``grow``
+    reuses it). Slot indices are stable for the pool's lifetime.
     """
 
     def __init__(
@@ -306,6 +313,7 @@ class ReplicaPool:
         self.max_batch = max_batch
         self._rr = 0
         self._alive = [True] * n_replicas
+        self._draining = [False] * n_replicas
         self._lock = threading.Lock()
 
     @property
@@ -313,9 +321,20 @@ class ReplicaPool:
         return len(self.engines)
 
     def alive(self) -> List[int]:
-        """Indices of live replicas."""
+        """Indices of live replicas (draining ones included — they are
+        still serving their in-flight batches)."""
         with self._lock:
             return [i for i, a in enumerate(self._alive) if a]
+
+    def routable(self) -> List[int]:
+        """Indices round-robin will hand out: alive and not draining —
+        the pool's effective serving capacity (the autoscaler's sizing
+        input)."""
+        with self._lock:
+            return [
+                i for i, a in enumerate(self._alive)
+                if a and not self._draining[i]
+            ]
 
     def kill(self, i: int) -> None:
         """Mark replica ``i`` dead: its predict raises ReplicaDead and
@@ -324,6 +343,7 @@ class ReplicaPool:
         failure."""
         with self._lock:
             self._alive[i] = False
+            self._draining[i] = False
 
     # Eviction after an observed failure is the same state change as a
     # chaos kill — one implementation, two call sites with different
@@ -353,16 +373,62 @@ class ReplicaPool:
         with self._lock:
             self.engines[i] = eng
             self._alive[i] = True
+            self._draining[i] = False
         return i
 
+    def grow(self, device=None) -> int:
+        """Add one serving replica; returns its slot index.
+
+        A dead slot (killed/retired and never respawned) is revived via
+        the respawn path — same machinery as failover recovery. With no
+        free slot, a fresh Engine is appended, pinned to the next device
+        in the round-robin placement (or ``device``). The Engine builds
+        OUTSIDE the pool lock (compiles can take a while) and publishes
+        atomically; existing slot indices never move."""
+        with self._lock:
+            free = [i for i, a in enumerate(self._alive) if not a]
+        if free:
+            return self.respawn(free[0], device=device)
+        eng = Engine(
+            self.handle,
+            params=self._params,
+            model_state=self._model_state,
+            max_batch=self.max_batch,
+            device=device if device is not None
+            else self.devices[len(self.engines) % len(self.devices)],
+            precompile=self._precompile,
+            obs=self.obs,
+        )
+        with self._lock:
+            self.engines.append(eng)
+            self._alive.append(True)
+            self._draining.append(False)
+            return len(self.engines) - 1
+
+    def drain(self, i: int) -> None:
+        """Make replica ``i`` unroutable while leaving it alive: no new
+        batch is pinned to it, but batches already dispatched to it
+        still execute. The scale-down half-step — ``retire`` completes
+        it once the caller has seen the in-flight count hit zero."""
+        with self._lock:
+            self._draining[i] = True
+
+    def retire(self, i: int) -> None:
+        """Free a drained slot: the replica is gone (predict raises
+        ReplicaDead) and the slot is available for a future ``grow``."""
+        with self._lock:
+            self._alive[i] = False
+            self._draining[i] = False
+
     def next_replica(self) -> int:
-        """Deterministic round-robin over LIVE replicas (dead slots are
-        skipped without consuming a turn for the survivors)."""
+        """Deterministic round-robin over ROUTABLE replicas (dead and
+        draining slots are skipped without consuming a turn for the
+        survivors)."""
         with self._lock:
             for _ in range(len(self.engines)):
                 i = self._rr
                 self._rr = (self._rr + 1) % len(self.engines)
-                if self._alive[i]:
+                if self._alive[i] and not self._draining[i]:
                     return i
         raise ReplicaDead(-1, "no live replicas in the pool")
 

@@ -104,3 +104,10 @@ def pytest_configure(config):
         "resize-lap loss parity, pure-reshard bit-exactness, chaos "
         "resize triggers, partial-ring recovery, serve replica failover)",
     )
+    config.addinivalue_line(
+        "markers",
+        "serve_slo: SLO-guarded serving tests (serve/admission.py, "
+        "serve/autoscaler.py, serve/scenarios.py — reject-early "
+        "shedding, degradation ladder, autoscaler stability, seeded "
+        "scenario gates incl. the slow-replica trip)",
+    )

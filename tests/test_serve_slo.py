@@ -1,0 +1,454 @@
+"""SLO-guarded serving tests: admission control, replica autoscaling,
+and the seeded chaos scenario gates (serve/admission.py,
+serve/autoscaler.py, serve/scenarios.py — ISSUE 13).
+
+The control-loop logic (ladder hysteresis, autoscaler streaks/cooldown)
+is tested against fake clocks and scripted stats so the assertions are
+exact; the end-to-end paths (flash-crowd shedding, drain-then-retire,
+the slow-replica gate trip) run a real tiny stack on CPU.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from parallel_cnn_tpu.config import ServeConfig
+from parallel_cnn_tpu.nn.core import Sequential
+from parallel_cnn_tpu.nn.layers import Dense, Flatten
+from parallel_cnn_tpu.resilience.chaos import ChaosMonkey
+from parallel_cnn_tpu.serve import (
+    AdmissionController,
+    AutoScaler,
+    Overloaded,
+    ServeStats,
+    scenarios,
+    serve_stack,
+)
+from parallel_cnn_tpu.serve.registry import ModelHandle
+
+pytestmark = pytest.mark.serve_slo
+
+IN_SHAPE = (4, 3)
+
+
+def tiny_handle():
+    model = Sequential([Flatten(), Dense(8)])
+
+    def init(key):
+        params, state, _ = model.init(key, IN_SHAPE)
+        return params, state
+
+    def forward(params, state, x):
+        return model.apply(params, state, x, train=False)[0]
+
+    return ModelHandle("tiny", IN_SHAPE, 8, init, forward)
+
+
+def tiny_cfg(**kw):
+    base = dict(
+        model="cifar_cnn", max_batch=4, max_wait_ms=5.0, queue_depth=64
+    )
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# chaos grammar: slow-replica@SEQ:MS
+
+
+class TestSlowReplicaSpec:
+    def test_parse(self):
+        m = ChaosMonkey.from_spec("slow-replica@3:250")
+        assert m.slow_replica == (3, 250.0)
+        assert m.slow_replica_at(2) is None
+        assert m.slow_replica_at(3) == 250.0
+        # One-shot: the same seq never fires twice.
+        assert m.slow_replica_at(3) is None
+        assert m.slow_replica_fired
+
+    def test_faults_coexist(self):
+        m = ChaosMonkey(kill_replica_seq=5, slow_replica=(2, 100.0))
+        assert m.kill_replica_seq == 5
+        assert m.slow_replica_at(2) == 100.0
+
+    @pytest.mark.parametrize(
+        "bad",
+        ["slow-replica@3", "slow-replica@3:", "slow-replica@3:0",
+         "slow-replica@3:-5", "slow-replica@x:100"],
+    )
+    def test_rejects_malformed(self, bad):
+        with pytest.raises(ValueError):
+            ChaosMonkey.from_spec(bad)
+
+
+# ---------------------------------------------------------------------------
+# windowed telemetry (fake clock → exact decay assertions)
+
+
+class TestWindowedStats:
+    def test_decay_and_views(self):
+        t = [0.0]
+        stats = ServeStats(window_s=1.0, clock=lambda: t[0])
+        for _ in range(4):
+            stats.on_submit()
+        stats.on_shed()
+        assert stats.window_shed_rate() == pytest.approx(0.25)
+        stats.on_batch(3, 4, replica=0, queue_depth=2)
+        assert stats.window_occupancy() == pytest.approx(0.75)
+        stats.on_complete(0.010)
+        p99 = stats.window_p99_ms()
+        assert p99 is not None and 5.0 < p99 < 20.0
+        # Ten time constants later the window has forgotten everything …
+        t[0] = 10.0
+        assert stats.window_shed_rate() == 0.0
+        assert stats.window_occupancy() is None
+        assert stats.window_p99_ms() is None
+        # … but the lifetime counters (the frozen contract) have not.
+        snap = stats.snapshot()
+        assert snap["submitted"] == 4 and snap["shed"] == 1
+        assert snap["completed"] == 1
+
+    def test_recent_dominates(self):
+        t = [0.0]
+        stats = ServeStats(window_s=1.0, clock=lambda: t[0])
+        for _ in range(10):
+            stats.on_submit()
+            stats.on_shed()
+        t[0] = 8.0  # old sheds decayed to ~3e-4 weight
+        for _ in range(10):
+            stats.on_submit()
+        assert stats.window_shed_rate() < 0.01
+        assert stats.shed_rate() == pytest.approx(0.5)  # lifetime view
+
+    def test_window_snapshot_keys(self):
+        stats = ServeStats(window_s=2.0)
+        ws = stats.window_snapshot()
+        assert set(ws) == {"window_s", "shed_rate", "occupancy", "p99_ms"}
+        assert ws["window_s"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# admission controller (fake clock → exact verdicts)
+
+
+class TestAdmission:
+    def test_cold_controller_admits(self):
+        ac = AdmissionController(slo_ms=50.0, queue_depth=16)
+        assert ac.admit(priority="guaranteed", deadline=None) is None
+        assert ac.predicted_wait_s() == 0.0
+
+    def test_reject_early_on_predicted_wait(self):
+        ac = AdmissionController(slo_ms=50.0, queue_depth=16,
+                                 clock=lambda: 100.0)
+        ac.observe_queue_wait(0.200)   # predicted 200 ms >> 50 ms SLO
+        reason = ac.admit(priority="guaranteed", deadline=None)
+        assert reason is not None and "exceeds" in reason
+        # A generous per-request deadline overrides the SLO budget.
+        assert ac.admit(priority="guaranteed", deadline=100.0 + 0.5) is None
+        snap = ac.snapshot()
+        assert snap["rejected_late"] == 1 and snap["admitted"] == 1
+
+    def test_service_ewma_feeds_prediction(self):
+        ac = AdmissionController(slo_ms=100.0, queue_depth=16)
+        ac.observe_queue_wait(0.010)
+        ac.observe_service(4, 0.030)
+        ac.observe_service(2, 0.005)
+        # Pessimistic bound: EWMA wait + slowest bucket.
+        assert ac.predicted_wait_s() == pytest.approx(0.040)
+
+    def test_ladder_walk_and_hysteresis(self):
+        ac = AdmissionController(slo_ms=100.0, queue_depth=100)
+        # One rung per admit call, pressure rising.
+        for depth, want in [(50, 1), (90, 2), (90, 3)]:
+            ac.admit(priority="guaranteed", deadline=None, queue_depth=depth)
+            assert ac.level == want
+        assert ac.level_name == "shed-best-effort"
+        # L3 sheds best-effort outright, admits guaranteed.
+        r = ac.admit(priority="best-effort", deadline=None, queue_depth=90)
+        assert r is not None and "best-effort" in r
+        assert ac.admit(priority="guaranteed", deadline=None,
+                        queue_depth=90) is None
+        # Hysteresis: fill just under the engage threshold does NOT
+        # release (release band is lower).
+        ac.admit(priority="guaranteed", deadline=None, queue_depth=85)
+        assert ac.level == 3
+        # Below the release thresholds the ladder walks back down.
+        for depth, want in [(60, 2), (40, 1), (10, 0)]:
+            ac.admit(priority="guaranteed", deadline=None, queue_depth=depth)
+            assert ac.level == want
+
+    def test_effective_knobs(self):
+        ac = AdmissionController(slo_ms=100.0, queue_depth=100)
+        assert ac.effective_wait_s(0.008) == 0.008
+        assert ac.effective_max_batch(8) == 8
+        ac.admit(priority="guaranteed", deadline=None, queue_depth=60)  # L1
+        assert ac.effective_wait_s(0.008) == pytest.approx(0.002)
+        assert ac.effective_max_batch(8) == 8
+        ac.admit(priority="guaranteed", deadline=None, queue_depth=80)  # L2
+        assert ac.effective_max_batch(8) == 4
+
+
+# ---------------------------------------------------------------------------
+# autoscaler control loop (scripted stats + fake clock → exact stability)
+
+
+class _ScriptedStats:
+    """Windowed-view stand-in the test scripts tick by tick."""
+
+    def __init__(self):
+        self.shed = 0.0
+        self.p99 = None
+        self.occ = None
+
+    def window_shed_rate(self):
+        return self.shed
+
+    def window_p99_ms(self):
+        return self.p99
+
+    def window_occupancy(self):
+        return self.occ
+
+
+class _FakePool:
+    def __init__(self, n=1, cap=4):
+        self.slots = [True] * n + [False] * (cap - n)
+        self.draining = [False] * cap
+        self.respawned = []
+
+    @property
+    def n_replicas(self):
+        return len(self.slots)
+
+    def routable(self):
+        return [i for i, a in enumerate(self.slots)
+                if a and not self.draining[i]]
+
+    def grow(self, device=None):
+        i = self.slots.index(False)
+        self.slots[i] = True
+        return i
+
+    def drain(self, i):
+        self.draining[i] = True
+
+    def retire(self, i):
+        self.slots[i] = False
+        self.draining[i] = False
+
+    def respawn(self, i, device=None):
+        self.slots[i] = True
+        self.draining[i] = False
+        self.respawned.append(i)
+
+
+class _FakeBatcher:
+    def __init__(self, stats):
+        self.stats = stats
+        self._runners = 1
+
+    @property
+    def n_runners(self):
+        return self._runners
+
+    def add_runner(self):
+        self._runners += 1
+
+    def inflight(self, replica):
+        return 0
+
+
+class TestAutoScalerLoop:
+    def _scaler(self, stats, pool, **kw):
+        t = [0.0]
+        kw.setdefault("min_replicas", 1)
+        kw.setdefault("max_replicas", 3)
+        kw.setdefault("hysteresis", 2)
+        kw.setdefault("cooldown_s", 1.0)
+        sc = AutoScaler(_FakePool() if pool is None else pool,
+                        _FakeBatcher(stats), clock=lambda: t[0], **kw)
+        return sc, t
+
+    def test_hysteresis_blocks_oscillation(self):
+        """An up/down signal alternating every tick never satisfies the
+        streak requirement — zero actions, zero flaps."""
+        stats = _ScriptedStats()
+        sc, t = self._scaler(stats, None)
+        for i in range(40):
+            t[0] += 0.1
+            if i % 2 == 0:
+                stats.shed, stats.p99, stats.occ = 0.5, 500.0, 0.9
+            else:
+                stats.shed, stats.p99, stats.occ = 0.0, 1.0, 0.05
+            sc.tick()
+        assert sc.actions == []
+        assert sc.direction_changes() == 0
+
+    def test_at_most_one_direction_change_per_cooldown(self):
+        """Sustained overload, then sustained underload, pressure
+        flipping every few ticks: every pair of consecutive actions is
+        separated by >= cooldown_s, so direction changes are rate-bound
+        to one per cooldown window (the no-flapping acceptance gate)."""
+        stats = _ScriptedStats()
+        sc, t = self._scaler(stats, None)
+        for i in range(200):
+            t[0] += 0.1
+            if (i // 5) % 2 == 0:   # 0.5 s overloaded, 0.5 s underloaded
+                stats.shed, stats.p99, stats.occ = 0.5, 500.0, 0.9
+            else:
+                stats.shed, stats.p99, stats.occ = 0.0, 1.0, 0.05
+            sc.tick()
+        assert len(sc.actions) >= 2   # the loop does act …
+        times = [a[0] for a in sc.actions]
+        gaps = [b - a for a, b in zip(times, times[1:])]
+        assert all(g >= sc.cooldown_s - 1e-9 for g in gaps)  # … slowly
+
+    def test_scale_up_to_max_then_down_to_min(self):
+        stats = _ScriptedStats()
+        pool = _FakePool(n=1, cap=3)
+        sc, t = self._scaler(stats, pool, hysteresis=1, cooldown_s=0.0)
+        stats.shed = 0.5
+        for _ in range(5):
+            t[0] += 0.1
+            sc.tick()
+        assert len(pool.routable()) == 3    # clamped at max_replicas
+        stats.shed, stats.p99, stats.occ = 0.0, 1.0, 0.05
+        for _ in range(5):
+            t[0] += 0.1
+            sc.tick()
+        assert len(pool.routable()) == 1    # clamped at min_replicas
+        assert sc.snapshot()["scale_ups"] == 2
+        assert sc.snapshot()["scale_downs"] == 2
+
+    def test_runner_threads_track_growth(self):
+        stats = _ScriptedStats()
+        pool = _FakePool(n=1, cap=2)
+        sc, t = self._scaler(stats, pool, hysteresis=1, cooldown_s=0.0,
+                             max_replicas=2)
+        stats.shed = 0.5
+        t[0] += 0.1
+        sc.tick()
+        assert sc.batcher.n_runners == pool.n_replicas == 2
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: admission shedding, drain loss-freedom, scenario gates
+
+
+class TestServeSLOEndToEnd:
+    def test_reject_early_vs_no_admission(self):
+        """The control experiment: identical stacks, one with a primed
+        admission controller predicting a hopeless wait. With admission
+        the submit is rejected typed and immediately; without it the
+        same request sails in and completes."""
+        ac = AdmissionController(slo_ms=50.0, queue_depth=64)
+        ac.observe_queue_wait(10.0)          # predicted wait: 10 s
+        pool_a, ba = serve_stack(tiny_handle(), tiny_cfg(), admission=ac)
+        pool_b, bb = serve_stack(tiny_handle(), tiny_cfg())
+        x = np.zeros(IN_SHAPE, np.float32)
+        with ba, bb:
+            with pytest.raises(Overloaded, match="admission rejected"):
+                ba.submit(x)
+            assert ba.stats.snapshot()["shed"] == 1
+            out = bb.submit(x).result(timeout=30.0)
+            assert out.shape == (8,)
+        # Conservation on the admission stack: 1 submitted == 1 shed.
+        snap = ba.stats.snapshot()
+        assert snap["submitted"] == snap["shed"] == 1
+        assert snap["completed"] == 0
+
+    def test_flash_crowd_conservation_under_admission_shedding(self):
+        """Flash-crowd through a primed admission controller: early
+        spike arrivals are rejected ahead of the queue, yet the
+        conservation law still balances client- and server-side."""
+        ac = AdmissionController(slo_ms=15.0, queue_depth=64)
+        ac.observe_queue_wait(0.050)     # predicted 50 ms > 15 ms budget
+        pool, b = serve_stack(
+            tiny_handle(), tiny_cfg(max_wait_ms=2.0), admission=ac
+        )
+        with b:
+            rep = scenarios.run("flash-crowd", b, seed=11,
+                                retry_attempts=1)
+        assert rep.conservation_ok, rep.to_dict()
+        assert rep.server["shed"] > 0          # admission really shed
+        assert rep.errors == 0
+        # Client-side ledger covers every logical request.
+        assert rep.requests == (
+            rep.completed + rep.shed + rep.expired + rep.errors
+        )
+
+    def test_scale_down_drain_loses_nothing(self):
+        """Drain-then-retire under live traffic: every future submitted
+        before and during the scale-down resolves; failed stays 0."""
+        pool, b = serve_stack(
+            tiny_handle(), tiny_cfg(n_replicas=2, max_wait_ms=1.0)
+        )
+        # Thresholds widened so live (unshedding) traffic classifies as
+        # underload — this test pins the drain barrier, not the policy.
+        sc = AutoScaler(pool, b, min_replicas=1, max_replicas=2,
+                        hysteresis=1, cooldown_s=0.0,
+                        slo_ms=1e6, occupancy_low=2.0)
+        x = np.zeros(IN_SHAPE, np.float32)
+        futures = []
+        stop = threading.Event()
+
+        def feeder():
+            while not stop.is_set():
+                try:
+                    futures.append(b.submit(x))
+                except Overloaded:
+                    pass
+                time.sleep(0.001)
+
+        with b:
+            th = threading.Thread(target=feeder, daemon=True)
+            th.start()
+            time.sleep(0.05)            # traffic in flight on both
+            deadline = time.monotonic() + 10.0
+            while len(pool.routable()) > 1:
+                sc.tick()
+                if time.monotonic() > deadline:
+                    pytest.fail("scale-down never completed")
+                time.sleep(0.005)
+            time.sleep(0.05)            # keep feeding the survivor
+            stop.set()
+            th.join(timeout=5)
+            for f in futures:
+                assert f.result(timeout=30.0).shape == (8,)
+        assert sc.snapshot()["scale_downs"] == 1
+        snap = b.stats.snapshot()
+        assert snap["failed"] == 0
+        assert snap["completed"] == len(futures)
+
+    def test_slow_replica_trips_p99_gate(self):
+        """chaos-slow with a 400 ms stall against a 150 ms gate MUST
+        report a p99 failure (anti-vacuity: the gate can fail) while
+        conservation holds through the straggler."""
+        pool, b = serve_stack(
+            tiny_handle(), tiny_cfg(max_wait_ms=1.0),
+            chaos=ChaosMonkey.from_spec("slow-replica@3:400"),
+        )
+        with b:
+            rep = scenarios.run("chaos-slow", b, seed=2)
+        assert b.chaos.slow_replica_fired      # the fault really ran
+        assert not rep.gates()["p99"], rep.to_dict()
+        assert rep.conservation_ok and rep.errors == 0
+        assert rep.p99_ms is not None and rep.p99_ms > 150.0
+
+    def test_chaos_scenario_refuses_unarmed_batcher(self):
+        pool, b = serve_stack(tiny_handle(), tiny_cfg())
+        with b:
+            with pytest.raises(ValueError, match="slow-replica"):
+                scenarios.run("chaos-slow", b, seed=0)
+
+    def test_diurnal_passes_clean(self):
+        pool, b = serve_stack(
+            tiny_handle(),
+            tiny_cfg(max_wait_ms=2.0, admission=True, slo_ms=200.0),
+        )
+        with b:
+            rep = scenarios.run("diurnal", b, seed=0)
+        assert rep.passed, rep.to_dict()
+        assert rep.shed == 0 and rep.server["shed"] == 0
