@@ -29,6 +29,15 @@
 #             (<= 1e-5) and pure-reshard bit-exactness. CPU-only and
 #             self-contained — gates commits like comm-multihost;
 #             ELASTIC_GATE is the contract line.
+#   async     straggler-tolerant async-DP gate (benches/run.py --suite
+#             comm, final leg): sync ring vs bounded-staleness (S=2) vs
+#             EASGD on the virtual-clock harness, clean and under chaos
+#             slow-worker@2:400, gated both ways (async holds >= 0.8x
+#             clean throughput while the sync ring is asserted to
+#             degrade below it) with seeded 3-step loss deltas <= 1e-2
+#             and the staleness ledger <= S. CPU-only and self-contained
+#             — gates commits like comm-multihost; ASYNC_GATE is the
+#             contract line.
 #   serve-chaos
 #             SLO-guarded serving gate (benches/run.py --suite serve):
 #             seeded scenario suites (diurnal / flash-crowd /
@@ -103,6 +112,23 @@ if [ "$MODE" = "elastic" ]; then
   RC=$?; echo "elastic rc=$RC" >> "$LOG"
   # The gate line is the contract: lap parity <= 1e-5 + bit-exact reshard.
   grep -q 'ELASTIC_GATE PASS' "$OUT" || RC=1
+  [ $RC -ne 0 ] && OVERALL=1
+  echo "=== playbook ${MODE} end rc=${OVERALL} $(date -u +%FT%TZ) ===" >> "$LOG"
+  exit $OVERALL
+fi
+
+if [ "$MODE" = "async" ]; then
+  echo "--- async straggler gate ---" >> "$LOG"
+  OUT="docs/async_${TAG}.txt"
+  # 8 virtual devices: the comm suite's ring/hier legs need the full
+  # emulated mesh; the async leg itself is host-side (virtual clock).
+  timeout 900 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python benches/run.py --quick --suite comm > "$OUT" 2>&1
+  RC=$?; echo "async rc=$RC" >> "$LOG"
+  # The gate line is the contract: both-ways straggler ratios + bounded
+  # loss deltas + ledger <= S.
+  grep -q 'ASYNC_GATE PASS' "$OUT" || RC=1
   [ $RC -ne 0 ] && OVERALL=1
   echo "=== playbook ${MODE} end rc=${OVERALL} $(date -u +%FT%TZ) ===" >> "$LOG"
   exit $OVERALL

@@ -637,6 +637,42 @@ def trace_entry_points(
             ),
         ))
 
+    # Async EASGD round (train/async_dp.py): the device-resident elastic
+    # pull/push over the data axis — center shards rematerialized with a
+    # ring all-gather, worker deltas pushed back with a ring
+    # reduce-scatter.  The center is master state (same contract as the
+    # ZeRO-3 param gathers), so both rings must carry f32 on the wire
+    # and cover the axis with a single cycle.
+    from jax.sharding import PartitionSpec as P
+
+    from parallel_cnn_tpu.parallel.mesh import shard_map
+    from parallel_cnn_tpu.train import async_dp
+
+    shard_len = 64
+    awf = jnp.zeros((n_data, n_data * shard_len), jnp.float32)
+    acs = jnp.zeros((n_data, shard_len), jnp.float32)
+
+    def _easgd_body(wf, cs):
+        new_w, new_c = async_dp.easgd_round_sharded(
+            wf[0], cs[0], jnp.float32(0.5),
+            axis_name="data", axis_size=n_data,
+        )
+        return new_w[None], new_c[None]
+
+    easgd_round = shard_map(
+        _easgd_body, mesh=mesh,
+        in_specs=(P("data", None), P("data", None)),
+        out_specs=(P("data", None), P("data", None)),
+        # ppermute outputs are per-device values the replication checker
+        # cannot prove replicated — same waiver as every ring caller.
+        check_vma=False,
+    )
+    out.append((
+        "train.easgd_round",
+        jax.make_jaxpr(easgd_round)(awf, acs),
+        None,
+    ))
+
     # Hierarchical two-level rings need a (host, device) mesh; 2 emulated
     # hosts over the local devices exercises every per-axis ppermute the
     # multi-host path emits (ring coverage is checked per axis).

@@ -18,6 +18,7 @@ from typing import List, Optional
 
 from parallel_cnn_tpu import obs as obs_lib
 from parallel_cnn_tpu.config import (
+    AsyncConfig,
     CommConfig,
     Config,
     DataConfig,
@@ -182,6 +183,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "kill-replica@SEQ kills the serving replica "
                         "holding dispatch batch SEQ (serve path); "
                         "slow-replica@SEQ:MS stalls it MS ms instead "
+                        "(serve path); slow-worker@STEP:MS stalls the "
+                        "training worker dispatching gradient step STEP "
+                        "for MS ms — the async-training straggler "
                         "(resilience/chaos.py has the full grammar)")
     p.add_argument("--elastic", action="store_true",
                    help="elastic training (PCNN_ELASTIC): on a preemption "
@@ -210,6 +214,28 @@ def build_parser() -> argparse.ArgumentParser:
                    help="never shrink the data world below N devices; "
                         "deeper chaos losses are clamped and journaled "
                         "[PCNN_ELASTIC_MIN_WORLD]")
+    p.add_argument("--async-mode", default=None,
+                   choices=["off", "stale", "easgd"],
+                   help="straggler-tolerant async data parallelism "
+                        "(train/async_dp.py): stale = bounded-staleness "
+                        "gradients with a hard barrier only at the bound, "
+                        "easgd = independent local SGD with a periodic "
+                        "elastic ρ-pull toward a bucket-sharded center; "
+                        "off / unset = the bulk-synchronous ring. Async "
+                        "modes trade bitwise sync parity for a bounded "
+                        "loss delta [PCNN_ASYNC_MODE]")
+    p.add_argument("--staleness-bound", type=int, default=None, metavar="S",
+                   help="max optimizer-step age of the params a gradient "
+                        "may be computed against (--async-mode stale; "
+                        "0 = bit-exact with the sync ring) "
+                        "[PCNN_ASYNC_STALENESS]")
+    p.add_argument("--easgd-period", type=int, default=None, metavar="N",
+                   help="local SGD steps between elastic-averaging rounds "
+                        "(--async-mode easgd) [PCNN_ASYNC_EASGD_PERIOD]")
+    p.add_argument("--easgd-rho", type=float, default=None, metavar="RHO",
+                   help="elastic-averaging pull strength in (0, 1]: worker "
+                        "and center each move ρ toward the other per round "
+                        "(--async-mode easgd) [PCNN_ASYNC_EASGD_RHO]")
     p.add_argument("--metrics", default=None, metavar="PATH",
                    help="append JSONL metrics records to PATH")
     _add_obs_flags(p)
@@ -346,10 +372,33 @@ def config_from_args(args: argparse.Namespace) -> Config:
                        if args.elastic_min_world is not None
                        else base.min_world),
         )
+    # And for the async data-parallel modes: PCNN_ASYNC_* env sets the
+    # base, any --async*/--staleness*/--easgd* flag overrides (and opts
+    # in).  --async-mode off explicitly pins the sync ring even when env
+    # vars are set.
+    async_dp = AsyncConfig.from_env()
+    if (args.async_mode is not None
+            or args.staleness_bound is not None
+            or args.easgd_period is not None
+            or args.easgd_rho is not None):
+        base = async_dp or AsyncConfig()
+        async_dp = dataclasses.replace(
+            base,
+            mode=args.async_mode or base.mode,
+            staleness_bound=(args.staleness_bound
+                             if args.staleness_bound is not None
+                             else base.staleness_bound),
+            easgd_period=(args.easgd_period
+                          if args.easgd_period is not None
+                          else base.easgd_period),
+            easgd_rho=(args.easgd_rho
+                       if args.easgd_rho is not None
+                       else base.easgd_rho),
+        )
     return Config(data=data, train=train, mesh=mesh,
                   resilience=resilience, comm=comm, fused=fused,
                   obs=_obs_config_from_args(args), elastic=elastic,
-                  model=args.model)
+                  async_dp=async_dp, model=args.model)
 
 
 def build_serve_parser(cmd: str) -> argparse.ArgumentParser:
@@ -717,6 +766,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     distributed.initialize()  # env-configured multi-host; no-op otherwise
 
     if cfg.model != "lenet_ref":
+        if cfg.async_dp is not None and cfg.async_dp.enabled:
+            raise SystemExit(
+                "--async-mode drives the lenet_ref virtual-clock harness "
+                "(train/async_dp.py); zoo models stay bulk-synchronous — "
+                "drop --async-mode or use --model lenet_ref"
+            )
         return _run_zoo(args, cfg)
     if cfg.elastic is not None and cfg.elastic.enabled:
         # The flat per-sample trainer has no sharded optimizer state to
@@ -729,6 +784,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     train_ds, test_ds = pipeline.load_train_test(cfg.data)
 
     chaos = ChaosMonkey.from_spec(args.chaos) if args.chaos else None
+    if cfg.async_dp is not None and cfg.async_dp.enabled:
+        return _run_async_lenet(args, cfg, train_ds, test_ds, chaos)
     ring = None
     if args.checkpoint_dir:
         ring = CheckpointRing(
@@ -814,6 +871,55 @@ def main(argv: Optional[List[str]] = None) -> int:
         phases = profiling.profile_phases(result.params, xs, ys)
         print(profiling.report(phases, n_images=xs.shape[0]))
 
+    return 0
+
+
+def _run_async_lenet(args, cfg: Config, train_ds, test_ds, chaos) -> int:
+    """Async data-parallel driver branch (--async-mode stale|easgd).
+
+    Runs the deterministic virtual-clock harness (train/async_dp.py):
+    N logical workers, each resident on its own shard of the training
+    set, real jitted gradients, virtual step durations — so throughput
+    and straggler tolerance replay exactly, chaos ``slow-worker@`` and
+    all.  One optimizer step consumes every worker's resident microbatch
+    once, so ``--epochs`` counts server steps (stale) / per-worker local
+    steps (easgd)."""
+    import jax
+    import jax.numpy as jnp
+
+    from parallel_cnn_tpu.models import lenet_ref
+    from parallel_cnn_tpu.resilience.sentinel import Sentinel
+    from parallel_cnn_tpu.train import async_dp, trainer
+
+    acfg = cfg.async_dp
+    w, b = acfg.workers, cfg.train.batch_size
+    if len(train_ds) < w * b:
+        raise SystemExit(
+            f"async harness wants {w} workers x {b} images, dataset has "
+            f"{len(train_ds)}"
+        )
+    xs = jnp.asarray(train_ds.images[: w * b]).reshape(w, b, 28, 28)
+    ys = jnp.asarray(train_ds.labels[: w * b]).reshape(w, b)
+    params = lenet_ref.init(jax.random.key(cfg.train.seed))
+    obs_bundle = obs_lib.from_config(cfg.obs, run="train_async")
+
+    result = async_dp.run_async(
+        params, xs, ys, cfg=acfg, dt=cfg.train.dt,
+        max_server_steps=cfg.train.epochs, chaos=chaos,
+        sentinel=Sentinel(), obs=obs_bundle,
+    )
+    for kind, path in obs_bundle.finish().items():
+        print(f"[obs] {kind} written to {path}")
+    print(
+        f"async mode={acfg.mode} steps={result.server_steps} "
+        f"microbatches={result.microbatches} "
+        f"virtual_ms={result.virtual_ms:.0f} "
+        f"max_staleness={result.ledger.max_staleness()} "
+        f"stragglers={result.stragglers} dropped={result.dropped} "
+        f"easgd_rounds={result.easgd_rounds}"
+    )
+    rate = trainer.test(result.params, test_ds)
+    print(f"async test error rate: {rate:.4f}")
     return 0
 
 

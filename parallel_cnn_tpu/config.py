@@ -513,6 +513,97 @@ class ElasticConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class AsyncConfig:
+    """Asynchronous data-parallel policy (train/async_dp.py — bounded
+    staleness per arXiv:1711.00705, EASGD elastic averaging per
+    arXiv:1605.08325; docs/fault_tolerance.md has the straggler state
+    machine).
+
+    The default (no AsyncConfig at all — Config.async_dp is None) keeps
+    every trainer bulk-synchronous: one slow worker stalls the whole
+    ring.  Constructing one (--async-mode / PCNN_ASYNC_MODE) opts into a
+    straggler-tolerant mode.  Async modes do NOT preserve bitwise parity
+    with the sync ring (except mode="stale" with staleness_bound=0,
+    which degenerates to the synchronous schedule) — the contract is a
+    bounded loss delta instead.
+    """
+
+    # "off"   — sync ring (same as Config.async_dp is None),
+    # "stale" — bounded-staleness SSP: a worker may apply gradients
+    #           computed against params up to `staleness_bound`
+    #           optimizer steps old; a hard barrier fires only when the
+    #           bound would be violated,
+    # "easgd" — elastic averaging: independent local SGD per worker plus
+    #           a periodic ρ-pull toward a shared center variable.
+    mode: str = "stale"
+    # Max optimizer-step age S of the params a gradient may be computed
+    # against (mode="stale").  0 = fully synchronous (bit-exact with the
+    # sync ring by construction).
+    staleness_bound: int = 2
+    # Local SGD steps between elastic-averaging rounds (mode="easgd").
+    easgd_period: int = 4
+    # Elastic-averaging pull strength ρ in (0, 1]: both the worker and
+    # the center move ρ of the way toward each other each round.
+    easgd_rho: float = 0.5
+    # Logical async workers the single-process scheduler simulates; in a
+    # multi-process run this is the process count instead.
+    workers: int = 4
+    # A completion later than this multiple of the nominal step duration
+    # journals a `straggler_detected` event.
+    straggler_factor: float = 2.0
+
+    def __post_init__(self):
+        if self.mode not in ("off", "stale", "easgd"):
+            raise ValueError(
+                f"unknown async mode {self.mode!r} (off, stale or easgd)"
+            )
+        if self.staleness_bound < 0:
+            raise ValueError(
+                f"staleness_bound must be >= 0, got {self.staleness_bound}"
+            )
+        if self.easgd_period < 1:
+            raise ValueError(
+                f"easgd_period must be >= 1, got {self.easgd_period}"
+            )
+        if not (0.0 < self.easgd_rho <= 1.0):
+            raise ValueError(
+                f"easgd_rho must be in (0, 1], got {self.easgd_rho}"
+            )
+        if self.workers < 1:
+            raise ValueError(f"workers must be >= 1, got {self.workers}")
+        if self.straggler_factor <= 1.0:
+            raise ValueError(
+                f"straggler_factor must be > 1, got {self.straggler_factor}"
+            )
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode != "off"
+
+    @staticmethod
+    def from_env() -> Optional["AsyncConfig"]:
+        """AsyncConfig from PCNN_ASYNC_MODE / PCNN_ASYNC_STALENESS /
+        PCNN_ASYNC_EASGD_PERIOD / PCNN_ASYNC_EASGD_RHO /
+        PCNN_ASYNC_WORKERS, or None when none of them is set (→ the
+        historical bulk-synchronous path)."""
+        mode = os.environ.get("PCNN_ASYNC_MODE")
+        bound = os.environ.get("PCNN_ASYNC_STALENESS")
+        period = os.environ.get("PCNN_ASYNC_EASGD_PERIOD")
+        rho = os.environ.get("PCNN_ASYNC_EASGD_RHO")
+        workers = os.environ.get("PCNN_ASYNC_WORKERS")
+        if (mode is None and bound is None and period is None
+                and rho is None and workers is None):
+            return None
+        return AsyncConfig(
+            mode=mode or "stale",
+            staleness_bound=int(bound) if bound else 2,
+            easgd_period=int(period) if period else 4,
+            easgd_rho=float(rho) if rho else 0.5,
+            workers=int(workers) if workers else 4,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class ObsConfig:
     """Observability policy (obs/ subsystem — span tracing with Perfetto
     export, the process-wide metrics registry, and the JSONL event
@@ -585,6 +676,10 @@ class Config:
     # an ElasticConfig opts the ZeRO-3 zoo trainer into in-flight
     # re-mesh + reshard-and-continue (resilience/elastic.py).
     elastic: Optional[ElasticConfig] = None
+    # None = bulk-synchronous training everywhere; an AsyncConfig opts
+    # into the straggler-tolerant bounded-staleness / EASGD data-parallel
+    # modes (train/async_dp.py).
+    async_dp: Optional[AsyncConfig] = None
     model: str = "lenet_ref"
 
     def replace(self, **kw) -> "Config":

@@ -23,6 +23,15 @@ The SLO-guarded serving layer adds ``admission_level`` (degradation-
 ladder transitions, serve/admission.py), ``scale_up`` / ``scale_down``
 (autoscaler decisions, serve/autoscaler.py), and ``chaos_slow_replica``
 (straggler injection, the slow-replica twin of the chaos kill).
+The async data-parallel trainer (train/async_dp.py) adds
+``chaos_slow_worker`` (the training twin of ``chaos_slow_replica``,
+injected at the microbatch dispatch boundary), ``straggler_detected``
+(a completion exceeded ``straggler_factor`` x the nominal step
+duration), ``staleness`` (per optimizer step: the group's max snapshot
+age and whether the hard barrier fired), ``easgd_round`` (one elastic-
+averaging ρ-pull, bracketed by the ``train.easgd_round`` span), and
+``sentinel_drop`` (a poisoned worker gradient rejected before it could
+reach the server/center params).
 """
 
 from __future__ import annotations

@@ -250,6 +250,12 @@ def distributed_init(coordinator: Optional[str] = None, num_processes: Optional[
             attempts=int(os.environ.get("PCNN_INIT_RETRIES", "3")),  # graftcheck: disable=env-outside-config -- bootstrap retry knob read at call time, shared contract with parallel.distributed
             base_delay=0.5,
         )
+        # Decorrelate the jitter stream per rank: after a straggler-
+        # induced timeout every worker rebuilds this same default policy,
+        # and identical delay sequences would re-stampede the coordinator
+        # in lockstep.  Deterministic per (seed, rank); the max_delay cap
+        # is unchanged.  An explicitly-passed policy is used verbatim.
+        retry = retry.decorrelated(rank=process_id or 0)
     retry_call(
         jax.distributed.initialize,
         coordinator,

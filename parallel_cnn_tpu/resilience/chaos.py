@@ -38,10 +38,18 @@ tests/test_resilience.py drives training through it end-to-end. Faults:
   the tail-latency fault the serving SLO gate exists to catch (and the
   harness for training straggler ablations later). One-shot, journaled
   by the batcher like ``kill-replica@``.
+- **Training-worker straggler at step N** (``slow_worker=(N, MS)``, spec
+  ``slow-worker@N:MS``): the data-parallel worker dispatching its N-th
+  gradient computation stalls for MS milliseconds — the training twin of
+  ``slow-replica@``, injected at the microbatch dispatch boundary so the
+  sync ring visibly stalls while the bounded-staleness/EASGD modes
+  (train/async_dp.py) visibly don't. One-shot, journaled
+  ``chaos_slow_worker``.
 
-The full CLI spec grammar (documented here, consumed by ``from_spec``):
-``nan@STEP`` | ``kill@EPOCH`` | ``kill9@EPOCH`` | ``resize@STEP:±K`` |
-``kill-replica@SEQ`` | ``slow-replica@SEQ:MS``.
+The full CLI spec grammar (``_GRAMMAR`` below, consumed by
+``from_spec``): ``nan@STEP`` | ``kill@EPOCH`` | ``kill9@EPOCH`` |
+``resize@STEP:±K`` | ``kill-replica@SEQ`` | ``slow-replica@SEQ:MS`` |
+``slow-worker@STEP:MS``.
 
 No wall clocks, no unseeded randomness — a chaos run replays exactly.
 """
@@ -56,6 +64,21 @@ from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+# Every spec kind ``from_spec`` accepts, in docstring order.  New kinds
+# register here so the grammar-error message (``_GRAMMAR``) names them
+# automatically — the two raise sites below share this one constant.
+SPEC_KINDS: Tuple[str, ...] = (
+    "nan@STEP",
+    "kill@EPOCH",
+    "kill9@EPOCH",
+    "resize@STEP:±K",
+    "kill-replica@SEQ",
+    "slow-replica@SEQ:MS",
+    "slow-worker@STEP:MS",
+)
+
+_GRAMMAR = "expected " + ", ".join(SPEC_KINDS[:-1]) + f" or {SPEC_KINDS[-1]}"
 
 
 def poison_tree(tree: Any) -> Any:
@@ -88,6 +111,7 @@ class ChaosMonkey:
         resize_delta: Optional[Tuple[int, int]] = None,
         kill_replica_seq: Optional[int] = None,
         slow_replica: Optional[Tuple[int, float]] = None,
+        slow_worker: Optional[Tuple[int, float]] = None,
     ):
         self.nan_step = nan_step
         self.kill_epoch = kill_epoch
@@ -101,12 +125,17 @@ class ChaosMonkey:
         # (seq, ms): the replica executing dispatched batch `seq` stalls
         # for `ms` milliseconds (serve/batcher.py polls slow_replica_at).
         self.slow_replica = slow_replica
+        # (step, ms): the training worker dispatching gradient step
+        # `step` stalls `ms` milliseconds (train/async_dp.py polls
+        # slow_worker_at at the microbatch dispatch boundary).
+        self.slow_worker = slow_worker
         self.steps_seen = 0
         self.nan_fired = False
         self.kill_fired = False
         self.resize_fired = False
         self.kill_replica_fired = False
         self.slow_replica_fired = False
+        self.slow_worker_fired = False
 
     def after_step(self, tree: Any, loss: Any) -> Tuple[Any, Any]:
         """Post-step hook: returns (possibly poisoned) (tree, loss)."""
@@ -168,22 +197,32 @@ class ChaosMonkey:
             return self.slow_replica[1]
         return None
 
+    def slow_worker_at(self, step: int) -> Optional[float]:
+        """Dispatch hook (async trainer): the straggler stall in
+        milliseconds, exactly once, for the worker dispatching gradient
+        step ``step``; None otherwise."""
+        if (
+            self.slow_worker is not None
+            and not self.slow_worker_fired
+            and step >= self.slow_worker[0]
+        ):
+            self.slow_worker_fired = True
+            return self.slow_worker[1]
+        return None
+
     @classmethod
     def from_spec(cls, spec: str) -> "ChaosMonkey":
-        """Parse a CLI fault spec (full grammar in the module docstring):
+        """Parse a CLI fault spec (full grammar in ``SPEC_KINDS``):
         ``nan@STEP``, ``kill@EPOCH`` (SIGTERM), ``kill9@EPOCH`` (SIGKILL),
         ``resize@STEP:±K`` (elastic world-size delta at step STEP),
         ``kill-replica@SEQ`` (serve replica death at dispatched batch
-        SEQ), or ``slow-replica@SEQ:MS`` (serve replica stalls MS ms at
-        dispatched batch SEQ)."""
+        SEQ), ``slow-replica@SEQ:MS`` (serve replica stalls MS ms at
+        dispatched batch SEQ), or ``slow-worker@STEP:MS`` (training
+        worker stalls MS ms dispatching gradient step STEP)."""
         kind, sep, arg = spec.partition("@")
         if not sep or not arg:
-            raise ValueError(
-                f"bad chaos spec {spec!r}; expected nan@STEP, kill@EPOCH, "
-                "kill9@EPOCH, resize@STEP:±K, kill-replica@SEQ or "
-                "slow-replica@SEQ:MS"
-            )
-        if kind == "slow-replica":
+            raise ValueError(f"bad chaos spec {spec!r}; {_GRAMMAR}")
+        if kind in ("slow-replica", "slow-worker"):
             seq, ssep, ms = arg.partition(":")
             try:
                 if not ssep:
@@ -191,12 +230,14 @@ class ChaosMonkey:
                 delay = float(ms)
                 if delay <= 0:
                     raise ValueError(arg)
+                if kind == "slow-worker":
+                    return cls(slow_worker=(int(seq), delay))
                 return cls(slow_replica=(int(seq), delay))
             except ValueError:
                 raise ValueError(
-                    f"bad chaos spec {spec!r}; slow-replica wants "
-                    "slow-replica@SEQ:MS with positive MS "
-                    "(e.g. slow-replica@2:250)"
+                    f"bad chaos spec {spec!r}; {kind} wants "
+                    f"{kind}@SEQ:MS with positive MS "
+                    f"(e.g. {kind}@2:250)"
                 ) from None
         if kind == "resize":
             step, ssep, delta = arg.partition(":")
@@ -213,11 +254,7 @@ class ChaosMonkey:
                     "resize@STEP:±K with nonzero K (e.g. resize@40:-4)"
                 ) from None
         if not arg.isdigit():
-            raise ValueError(
-                f"bad chaos spec {spec!r}; expected nan@STEP, kill@EPOCH, "
-                "kill9@EPOCH, resize@STEP:±K, kill-replica@SEQ or "
-                "slow-replica@SEQ:MS"
-            )
+            raise ValueError(f"bad chaos spec {spec!r}; {_GRAMMAR}")
         n = int(arg)
         if kind == "nan":
             return cls(nan_step=n)

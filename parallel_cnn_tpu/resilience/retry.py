@@ -64,6 +64,24 @@ class RetryPolicy:
             d = min(self.base_delay * self.multiplier**k, self.max_delay)
             yield d * (1.0 + self.jitter * (2.0 * rng.random() - 1.0))
 
+    def decorrelated(self, rank: int = 0) -> "RetryPolicy":
+        """Per-rank decorrelation of the SAME policy envelope.
+
+        N workers recovering from one straggler-induced timeout all build
+        the identical policy, so plain ``delays()`` has them reconnect in
+        lockstep and re-stampede the coordinator.  This derives a policy
+        whose jitter stream is seeded by ``(seed, rank)`` — deterministic
+        per worker (replayable), decorrelated across workers (no thundering
+        herd).  The backoff *envelope* — base, multiplier, and above all
+        the ``max_delay`` cap — is unchanged; only the jitter draw differs.
+        """
+        if rank < 0:
+            raise ValueError(f"rank must be >= 0, got {rank}")
+        # Integer fold of (seed, rank) — stable across processes and
+        # Python versions (no reliance on object hashing).
+        derived = random.Random(self.seed * 1_000_003 + rank).getrandbits(32)
+        return dataclasses.replace(self, seed=derived)
+
 
 def retry_call(
     fn: Callable,

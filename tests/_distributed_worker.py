@@ -29,8 +29,13 @@ tests/conftest.py), joins the coordination service, and runs:
    steps — asserting in-process that the 3-step loss trajectory matches
    a fixed-mesh run (≤1e-5) and that the reshard itself is bit-exact.
 
+7. One EASGD elastic-averaging round (train/async_dp.easgd_round_sharded)
+   over the full global data ring: the center all-gather and the delta
+   reduce-scatter are genuine cross-process ppermutes, asserted against
+   a host-side numpy reference by the parent.
+
 Prints parseable RESULT / TRAIN / TRAIN2D / TRAINHIER / TRAINZ3 /
-TRAINELASTIC lines for the parent to assert on.
+TRAINELASTIC / TRAINASYNC lines for the parent to assert on.
 """
 
 import os
@@ -378,6 +383,48 @@ def train_trajectory_elastic():
     return max_dloss, bitexact
 
 
+def train_trajectory_async():
+    """One EASGD ρ-pull round over the REAL 2-process data ring — the
+    center all-gather and the delta reduce-scatter inside
+    easgd_round_sharded hop across the process boundary. Returns summed
+    digests of the new worker block and the new center (replicated via
+    jit so both ranks can read them); the parent recomputes both from
+    the same seed with numpy."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from parallel_cnn_tpu.config import MeshConfig
+    from parallel_cnn_tpu.parallel import mesh as mesh_lib
+    from parallel_cnn_tpu.train import async_dp
+
+    n = len(jax.devices())
+    mesh = mesh_lib.make_mesh(MeshConfig(data=n, model=1))
+    shard_len = 32
+    rng = np.random.default_rng(99)
+    wf_host = rng.normal(size=(n, n * shard_len)).astype(np.float32)
+    cs_host = rng.normal(size=(n, shard_len)).astype(np.float32)
+    row = NamedSharding(mesh, P("data", None))
+    wf = _globalize(mesh, wf_host, row)
+    cs = _globalize(mesh, cs_host, row)
+
+    def body(w, c):
+        nw, nc = async_dp.easgd_round_sharded(
+            w[0], c[0], jnp.float32(0.5), axis_name="data", axis_size=n
+        )
+        return nw[None], nc[None]
+
+    f = jax.jit(mesh_lib.shard_map(
+        body, mesh=mesh, in_specs=(P("data", None), P("data", None)),
+        out_specs=(P("data", None), P("data", None)), check_vma=False,
+    ))
+    nw, nc = f(wf, cs)
+    rep = NamedSharding(mesh, P())
+    dw, dc = jax.jit(
+        lambda a, b: (jnp.sum(a), jnp.sum(b)), out_shardings=(rep, rep)
+    )(nw, nc)
+    return float(dw), float(dc)
+
+
 def main() -> int:
     joined = distributed.initialize()
     assert joined, "PCNN_* env must configure a 2-process run"
@@ -410,6 +457,9 @@ def main() -> int:
 
     max_dloss, bitexact = train_trajectory_elastic()
     print(f"TRAINELASTIC {max_dloss:.8e} {bitexact}", flush=True)
+
+    adw, adc = train_trajectory_async()
+    print(f"TRAINASYNC {adw:.6e} {adc:.6e}", flush=True)
     return 0
 
 

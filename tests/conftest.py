@@ -111,3 +111,10 @@ def pytest_configure(config):
         "shedding, degradation ladder, autoscaler stability, seeded "
         "scenario gates incl. the slow-replica trip)",
     )
+    config.addinivalue_line(
+        "markers",
+        "async_dp: asynchronous data-parallel tests (train/async_dp.py "
+        "— staleness ledger, stale-0 sync parity, EASGD center "
+        "convergence, slow-worker chaos, sentinel drop, decorrelated "
+        "retry jitter)",
+    )

@@ -381,6 +381,27 @@ def test_two_process_distributed_smoke(tmp_path):
         f"elastic resize broke loss parity: max dloss {max_dloss}"
     assert bitexact == "1", "pure reshard was not bit-exact"
 
+    # EASGD elastic-averaging round over the real cross-process ring
+    # (train/async_dp.easgd_round_sharded): ranks agree, and the summed
+    # digests match the host-side numpy reference of one ρ-pull.
+    async_lines = []
+    for out in outs:
+        line = [l for l in out.splitlines()
+                if l.startswith("TRAINASYNC")][0]
+        async_lines.append(line.split()[1:3])
+    assert async_lines[0] == async_lines[1], "async: ranks diverged"
+    got_dw, got_dc = (float(v) for v in async_lines[0])
+    n_dev, shard_len, rho = 8, 32, 0.5
+    arng = np.random.default_rng(99)  # mirrors train_trajectory_async
+    wf = arng.normal(size=(n_dev, n_dev * shard_len)).astype(np.float32)
+    cs = arng.normal(size=(n_dev, shard_len)).astype(np.float32)
+    center = cs.reshape(-1)
+    delta = rho * (wf - center[None, :])
+    want_dw = float(np.sum(wf - delta))
+    want_dc = float(np.sum(center + np.mean(delta, axis=0)))
+    np.testing.assert_allclose(got_dw, want_dw, rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(got_dc, want_dc, rtol=1e-4, atol=1e-3)
+
 
 def test_cli_zoo_profile_writes_trace(tmp_path):
     """Zoo --profile captures a jax.profiler trace of steady-state steps
