@@ -38,6 +38,13 @@
 #             and the staleness ledger <= S. CPU-only and self-contained
 #             — gates commits like comm-multihost; ASYNC_GATE is the
 #             contract line.
+#   pipeline  1F1B pipeline-parallel gate (benches/run.py --suite
+#             pipeline): stages 1/2/4 over the (stage, data) mesh on 8
+#             virtual CPU devices, gated on stages=1 bit-exactness and
+#             stages 2/4 <= 1e-5 parity vs the flat data ring, plus the
+#             schedule-counted bubble fraction equal to the closed form
+#             (S-1)/(S-1+M). CPU-only and self-contained — gates commits
+#             like comm-multihost; PIPELINE_GATE is the contract line.
 #   serve-chaos
 #             SLO-guarded serving gate (benches/run.py --suite serve):
 #             seeded scenario suites (diurnal / flash-crowd /
@@ -129,6 +136,23 @@ if [ "$MODE" = "async" ]; then
   # The gate line is the contract: both-ways straggler ratios + bounded
   # loss deltas + ledger <= S.
   grep -q 'ASYNC_GATE PASS' "$OUT" || RC=1
+  [ $RC -ne 0 ] && OVERALL=1
+  echo "=== playbook ${MODE} end rc=${OVERALL} $(date -u +%FT%TZ) ===" >> "$LOG"
+  exit $OVERALL
+fi
+
+if [ "$MODE" = "pipeline" ]; then
+  echo "--- pipeline 1F1B gate ---" >> "$LOG"
+  OUT="docs/pipeline_${TAG}.txt"
+  # 8 virtual devices: the stages 1/2/4 sweep needs (1,8)/(2,4)/(4,2)
+  # (stage, data) meshes over a full-size device set.
+  timeout 900 env JAX_PLATFORMS=cpu PCNN_JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python benches/run.py --quick --suite pipeline > "$OUT" 2>&1
+  RC=$?; echo "pipeline rc=$RC" >> "$LOG"
+  # The gate line is the contract: parity (bit-exact / <= 1e-5) + the
+  # schedule bubble equal to (S-1)/(S-1+M).
+  grep -q 'PIPELINE_GATE PASS' "$OUT" || RC=1
   [ $RC -ne 0 ] && OVERALL=1
   echo "=== playbook ${MODE} end rc=${OVERALL} $(date -u +%FT%TZ) ===" >> "$LOG"
   exit $OVERALL

@@ -46,6 +46,7 @@ LIVE_DOCS = (
     "docs/kernel_authoring.md",
     "docs/static_analysis.md",
     "docs/observability.md",
+    "docs/pipeline.md",
     "docs/future_work.md",
 )
 
@@ -222,8 +223,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="cost report output (default "
                          "analysis/cost_report.json)")
     ap.add_argument("--cost-seeded", default=None, metavar="NAME",
-                    help="append a seeded mutant entry (bf16-master-gather) "
-                         "— the anti-vacuity leg of the dryrun")
+                    help="append a seeded mutant entry (bf16-master-gather, "
+                         "partial-stage-ring) — the anti-vacuity leg of "
+                         "the dryrun")
     ap.add_argument("--json", type=Path, default=None, metavar="PATH",
                     help="also write diagnostics as JSON")
     ap.add_argument("--verbose", "-v", action="store_true",

@@ -159,6 +159,11 @@ def expected_collective_bytes(spec: EntrySpec) -> Tuple[int, int]:
     - zero2_ring:    ICI K·(D−1)·E/D·w + (D−1)·E/D·4  (param AG f32)
     - zero3_ring:    identical to zero2_ring (head gather instead of tail)
     - zero3_hier:    ICI as zero2; DCN K·(H−1)·E/(D·H)·w + (H−1)·E/(D·H)·4
+    - pipeline_ring: ICI 2·2(M+S−1)·P + 2·(D−1)·E/D·w — the 1F1B stage
+      wires (2 full-cycle ppermutes per tick × T = 2(M+S−1) ticks, each
+      carrying the uniform P = mb·A_buf·w_stage payload, docs/pipeline.md)
+      plus ONE post-accumulation grad ring all-reduce (RS+AG) over the
+      data axis
     """
     k, d, h, w = spec.accum, spec.n_dev, spec.n_host, spec.wire_itemsize
     ici = 0
@@ -176,8 +181,13 @@ def expected_collective_bytes(spec: EntrySpec) -> Tuple[int, int]:
         elif spec.kind == "zero3_hier":
             ici += k * dev_pass * w + dev_pass * 4
             dcn += k * host_pass * w + host_pass * 4
+        elif spec.kind == "pipeline_ring":
+            ici += 2 * dev_pass * w
         else:
             raise ValueError(f"unknown cost kind {spec.kind!r}")
+    if spec.kind == "pipeline_ring":
+        ticks = 2 * (spec.pipe_micro + spec.n_stage - 1)
+        ici += 2 * ticks * spec.stage_payload_bytes
     return ici, dcn
 
 
@@ -186,6 +196,14 @@ def peak_hbm_bytes(spec: EntrySpec) -> int:
     residency + activation high-water mark + the f32 1/n gradient shard
     accumulators every schedule keeps across microbatches."""
     shards = spec.n_dev * spec.n_host
+    if spec.kind == "pipeline_ring":
+        # The 1F1B step accumulates the FULL per-stage grad tree (the
+        # stage psum adds exact zeros, so the accumulator spans every
+        # bucket) and keeps the f32 activation stash live across the
+        # whole tick loop.
+        grad_accum = sum(spec.bucket_elems) * 4
+        return (spec.resident_bytes + spec.act_bytes + grad_accum
+                + spec.stash_bytes)
     grad_accum = sum(e // shards for e in spec.bucket_elems) * 4
     return spec.resident_bytes + spec.act_bytes + grad_accum
 
@@ -214,6 +232,12 @@ def build_seeded_entry(name: str):
     gather the schedule is REQUIRED to use (kind zero3_ring, accum 0), so
     the measured bf16 hop bytes contradict the closed form
     (cost-model-mismatch) on top of the f32-wire jaxpr rule.
+
+    ``partial-stage-ring``: a stage-axis ppermute whose permutation stops
+    one hop short of the cycle — the last stage's cotangent never reaches
+    stage 0.  Trips ``ring-permutation`` (not a single full cycle) and,
+    because its EntrySpec pins the full-ring 1F1B closed form
+    (kind pipeline_ring), ``cost-model-mismatch`` as well.
     """
     import jax
     import jax.numpy as jnp
@@ -224,6 +248,36 @@ def build_seeded_entry(name: str):
     from parallel_cnn_tpu.parallel import mesh as mesh_lib
     from parallel_cnn_tpu.parallel.mesh import DATA_AXIS
 
+    if name == "partial-stage-ring":
+        from parallel_cnn_tpu.parallel.mesh import (
+            DATA_AXIS as _DA, STAGE_AXIS, make_pipeline_mesh,
+        )
+
+        n = len(jax.devices())
+        n_stage = 2
+        pmesh = make_pipeline_mesh(n_stage)
+        a_buf = 256
+
+        def pbody(buf):
+            # One hop short of the cycle: stage S-1 sends to nobody.
+            perm = [(i, i + 1) for i in range(n_stage - 1)]
+            out = jax.lax.ppermute(buf, STAGE_AXIS, perm)
+            return jax.lax.pmean(out, (_DA, STAGE_AXIS))
+
+        step = mesh_lib.shard_map(
+            pbody, mesh=pmesh, in_specs=(P(),), out_specs=P(),
+            check_vma=False,
+        )
+        closed = jax.make_jaxpr(step)(jnp.zeros((1, a_buf), jnp.float32))
+        spec = EntrySpec(
+            kind="pipeline_ring", n_dev=n // n_stage, n_host=1, accum=2,
+            wire_itemsize=4, bucket_elems=(a_buf,),
+            resident_bytes=a_buf * 4, act_bytes=0, images_per_step=1,
+            n_state_leaves=1, n_stage=n_stage, pipe_micro=2,
+            stage_payload_bytes=a_buf * 4,
+            stash_bytes=n_stage * a_buf * 4,
+        )
+        return (f"seeded.{name}", closed, spec)
     if name != "bf16-master-gather":
         raise ValueError(f"unknown seeded mutation {name!r}")
     n = len(jax.devices())

@@ -47,7 +47,7 @@ from parallel_cnn_tpu.analysis.diagnostics import Diagnostic, Severity
 
 # Declared mesh axes (parallel/mesh.py DATA_AXIS/MODEL_AXIS/HOST_AXIS).
 # Sizes are unknown (None) until a shard_map mesh refines them.
-DECLARED_AXES = {"data", "model", "host"}
+DECLARED_AXES = {"data", "model", "host", "stage"}
 
 # Primitives that only rearrange/retag values: a ppermute output flowing
 # through ONLY these to a jaxpr output means the wire dtype is what the
@@ -404,7 +404,8 @@ class EntrySpec:
     """
 
     kind: str            # ring_overlap | hier_overlap | zero2_ring |
-                         # zero3_ring | zero3_hier (docs/collectives.md)
+                         # zero3_ring | zero3_hier | pipeline_ring
+                         # (docs/collectives.md)
     n_dev: int           # device-axis ring size D (intra-host / ICI)
     n_host: int          # host-axis ring size H (1 on flat meshes / DCN)
     accum: int           # K gradient-accumulation microbatches per step
@@ -417,6 +418,13 @@ class EntrySpec:
     n_state_leaves: int  # leaves of the ZooState pytree (sharding_prop)
     transient_gather_bytes: int = 0  # zero3 head-gather peak (full f32
                                      # params, freed before backward)
+    n_stage: int = 1     # pipeline stage-axis ring size S (1 = no pipe)
+    pipe_micro: int = 0  # pipeline microbatch count M (the 1F1B tick
+                         # count is 2(M+S-1); 0 on non-pipeline entries)
+    stage_payload_bytes: int = 0  # one stage-wire ppermute payload:
+                                  # mb*A_buf*wire itemsize (docs/pipeline.md)
+    stash_bytes: int = 0  # f32 activation stash: S*mb*A_buf*4 resident
+                          # across the whole tick loop
 
 
 def _tree_bytes(tree) -> int:
@@ -635,6 +643,89 @@ def trace_entry_points(
                 n_state_leaves=len(jax.tree_util.tree_leaves(zst)),
                 transient_gather_bytes=sum(zplan.bucket_sizes) * 4,
             ),
+        ))
+
+    # Pipeline 1F1B entries (train/pipeline_schedule.py): the (stage,
+    # data) mesh's fwd/bwd stage wires are full-cycle ppermute rings
+    # fired EVERY tick — ring coverage is checked per axis, and the cost
+    # accountant pins the tick count 2(M+S-1) exactly.  A small model
+    # keeps the unrolled tick-loop trace cheap; the rules don't care
+    # about layer count.  pipe4 sends the stage wire in bf16 — legal
+    # (activations/cotangents, not masters), and a regression guard that
+    # the f32-wire rule doesn't misfire through the tick switch.
+    if n_dev >= 8 and n_dev % 4 == 0:
+        from parallel_cnn_tpu.config import PipelineConfig
+        from parallel_cnn_tpu.nn import layers as nn_layers
+        from parallel_cnn_tpu.nn.core import Sequential
+        from parallel_cnn_tpu.parallel import pipeline as pipe_lib
+        from parallel_cnn_tpu.train import pipeline_schedule
+
+        pmodel = Sequential([
+            nn_layers.Conv2D(4, (3, 3)), nn_layers.ReLU(),
+            nn_layers.MaxPool(), nn_layers.Flatten(), nn_layers.Dense(10),
+        ])
+        pin_shape = (8, 8, 3)
+        ring_f32 = CommConfig(impl="ring")
+        for tag, n_stage, stage_wire in (
+            ("pipe2_ring", 2, "float32"),
+            ("pipe4_ring", 4, "bfloat16"),
+        ):
+            n_pdata = n_dev // n_stage
+            pmesh = mesh_lib.make_pipeline_mesh(n_stage)
+            pcfg = PipelineConfig(stages=n_stage, wire_dtype=stage_wire)
+            popt = zoo.make_optimizer(0.01, momentum=0.9)
+            pst = zoo.init_state(pmodel, jax.random.key(1), pin_shape, popt)
+            pstep = pipeline_schedule.make_pipeline_step(
+                pmodel, popt, accum_steps=2, mesh=pmesh,
+                pipeline=pcfg, in_shape=pin_shape, comm=ring_f32,
+            )
+            px = jnp.zeros((2 * n_pdata, *pin_shape), jnp.float32)
+            py = jnp.zeros((2 * n_pdata,), jnp.int32)
+            bounds, _, _ = pipeline_schedule.stage_plan(
+                pmodel, pcfg, pin_shape
+            )
+            a_buf = pipe_lib.wire_numel(pmodel, pin_shape, bounds, 1)
+            pplan = collectives.plan_buckets(
+                pst.params, ring_f32.bucket_bytes, shards=n_pdata
+            )
+            w_stage = 2 if stage_wire == "bfloat16" else 4
+            out.append((
+                f"train.pipeline_step.{tag}",
+                jax.make_jaxpr(pstep)(pst, px, py),
+                EntrySpec(
+                    kind="pipeline_ring", n_dev=n_pdata, n_host=1,
+                    accum=2, wire_itemsize=4,
+                    bucket_elems=tuple(pplan.bucket_sizes),
+                    resident_bytes=_tree_bytes(pst),
+                    act_bytes=_activation_hwm(
+                        pmodel, pst.params, pst.model_state, 1,
+                        pin_shape, 4
+                    ),
+                    images_per_step=2 * n_pdata,
+                    n_state_leaves=len(jax.tree_util.tree_leaves(pst)),
+                    n_stage=n_stage, pipe_micro=2,
+                    stage_payload_bytes=1 * a_buf * w_stage,
+                    stash_bytes=n_stage * 1 * a_buf * 4,
+                ),
+            ))
+
+        # stages=1 degenerate twin: the same make_pipeline_step surface
+        # delegating to the flat data-ring step — traced so the
+        # degenerate path stays clean under every rule, like any entry.
+        pmesh1 = mesh_lib.make_pipeline_mesh(1)
+        popt = zoo.make_optimizer(0.01, momentum=0.9)
+        pst1 = zoo.init_state(pmodel, jax.random.key(1), pin_shape, popt)
+        pstep1 = pipeline_schedule.make_pipeline_step(
+            pmodel, popt, accum_steps=2, mesh=pmesh1,
+            pipeline=PipelineConfig(stages=1), in_shape=pin_shape,
+            comm=ring_f32,
+        )
+        px1 = jnp.zeros((2 * n_dev, *pin_shape), jnp.float32)
+        py1 = jnp.zeros((2 * n_dev,), jnp.int32)
+        out.append((
+            "train.pipeline_step.pipe1_degenerate",
+            jax.make_jaxpr(pstep1)(pst1, px1, py1),
+            None,
         ))
 
     # Async EASGD round (train/async_dp.py): the device-resident elastic

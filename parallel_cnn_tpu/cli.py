@@ -26,6 +26,7 @@ from parallel_cnn_tpu.config import (
     FusedStepConfig,
     MeshConfig,
     ObsConfig,
+    PipelineConfig,
     ResilienceConfig,
     ServeConfig,
     TrainConfig,
@@ -133,6 +134,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "unset): derive one host row per jax.distributed "
                         "process; an explicit N splits one process's "
                         "devices into N emulated hosts (CPU testing)")
+    p.add_argument("--pipeline-stages", type=int, default=None, metavar="S",
+                   help="zoo mesh runs: pipeline parallelism — partition "
+                        "the model's layers over S stages of a (stage, "
+                        "data) mesh and run the 1F1B microbatch schedule "
+                        "(train/pipeline_schedule.py; --accum-steps is "
+                        "the microbatch count M). Builds its own mesh "
+                        "over all devices; drop --mesh-data/--mesh-model."
+                        " S=1 is the degenerate single-stage pipeline "
+                        "(bit-exact vs the flat data mesh) "
+                        "[PCNN_PIPELINE_STAGES]")
+    p.add_argument("--pipeline-split", default=None, metavar="B1,B2,..",
+                   help="manual stage boundaries (layer indices, "
+                        "stages-1 of them); default: balanced split from "
+                        "the analysis/cost_model.py per-layer flops "
+                        "tables [PCNN_PIPELINE_SPLIT]")
+    p.add_argument("--pipeline-wire-dtype", default=None,
+                   choices=["float32", "bfloat16"],
+                   help="dtype of the inter-stage activation/cotangent "
+                        "ppermute payload; accumulation stays f32 "
+                        "[PCNN_PIPELINE_WIRE_DTYPE]")
+    p.add_argument("--pipeline-act-dtype", default=None,
+                   choices=["float32", "bfloat16"],
+                   help="stage-compute activation dtype (params cast "
+                        "per-layer, grads/loss stay f32) "
+                        "[PCNN_PIPELINE_ACT_DTYPE]")
     p.add_argument("--fused-step", action="store_true",
                    help="fused training step (PCNN_FUSED_STEP): fused "
                         "pool→FC→softmax-CE loss tail, bf16 activations "
@@ -185,7 +211,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "slow-replica@SEQ:MS stalls it MS ms instead "
                         "(serve path); slow-worker@STEP:MS stalls the "
                         "training worker dispatching gradient step STEP "
-                        "for MS ms — the async-training straggler "
+                        "for MS ms — the async-training straggler; "
+                        "slow-stage@STEP:MS stalls the pipeline trainer "
+                        "MS ms at the step-STEP dispatch boundary — the "
+                        "1F1B straggler (needs --pipeline-stages) "
                         "(resilience/chaos.py has the full grammar)")
     p.add_argument("--elastic", action="store_true",
                    help="elastic training (PCNN_ELASTIC): on a preemption "
@@ -354,6 +383,23 @@ def config_from_args(args: argparse.Namespace) -> Config:
                 "--fused-step (or PCNN_FUSED_STEP=1) first"
             )
         fused = dataclasses.replace(fused, act_dtype=args.act_dtype)
+    # Same layering for the pipeline: PCNN_PIPELINE_* env sets the base,
+    # any --pipeline-* flag overrides field-by-field (and opts in).
+    pipeline = PipelineConfig.from_env()
+    if (args.pipeline_stages is not None
+            or args.pipeline_split is not None
+            or args.pipeline_wire_dtype is not None
+            or args.pipeline_act_dtype is not None):
+        base = pipeline or PipelineConfig()
+        pipeline = dataclasses.replace(
+            base,
+            stages=(args.pipeline_stages
+                    if args.pipeline_stages is not None else base.stages),
+            split=(args.pipeline_split
+                   if args.pipeline_split is not None else base.split),
+            wire_dtype=args.pipeline_wire_dtype or base.wire_dtype,
+            act_dtype=args.pipeline_act_dtype or base.act_dtype,
+        )
     # Same layering for the elastic runtime: PCNN_ELASTIC* env sets the
     # base, any --elastic* flag overrides field-by-field (and opts in).
     elastic = ElasticConfig.from_env()
@@ -398,7 +444,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
     return Config(data=data, train=train, mesh=mesh,
                   resilience=resilience, comm=comm, fused=fused,
                   obs=_obs_config_from_args(args), elastic=elastic,
-                  async_dp=async_dp, model=args.model)
+                  async_dp=async_dp, pipeline=pipeline, model=args.model)
 
 
 def build_serve_parser(cmd: str) -> argparse.ArgumentParser:
@@ -974,7 +1020,23 @@ def _run_zoo(args: argparse.Namespace, cfg: Config) -> int:
     mesh = None
     model_axis = (args.mesh_model or 1) > 1
     hier = cfg.comm is not None and cfg.comm.impl == "hierarchical"
-    if hier:
+    if cfg.pipeline is not None:
+        # The pipeline brings its own (stage, data) mesh over ALL
+        # devices; the flat mesh flags and the hierarchical (host,
+        # device) mesh don't describe it.
+        if args.mesh_data is not None or model_axis:
+            raise SystemExit(
+                "--pipeline-stages builds its own (stage, data) mesh "
+                "over all devices; drop --mesh-data/--mesh-model"
+            )
+        if hier:
+            raise SystemExit(
+                "pipeline gradients reduce over the flat data axis; "
+                "use --comm-impl ring (not hierarchical)"
+            )
+        mesh = mesh_lib.make_pipeline_mesh(cfg.pipeline.stages)
+        print(f"mesh: {dict(mesh.shape)} (pipeline)")
+    elif hier:
         # The hierarchical path brings its own 2-level (host, device) mesh
         # over ALL devices — the flat mesh flags don't describe it.
         if args.mesh_data is not None or model_axis:
@@ -1040,6 +1102,7 @@ def _run_zoo(args: argparse.Namespace, cfg: Config) -> int:
             chaos=chaos,
             obs=obs_bundle,
             elastic=cfg.elastic,
+            pipeline=cfg.pipeline,
             # Zoo --profile = a jax.profiler trace of 3 steady-state steps
             # of THE run's own jitted step (augment/schedule/accum/mesh
             # included; compile excluded) — the single-chip MFU attribution

@@ -655,6 +655,99 @@ class ObsConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class PipelineConfig:
+    """Pipeline-parallelism policy (parallel/pipeline.py +
+    train/pipeline_schedule.py — GPipe-style 1F1B microbatch pipelining
+    over a ``(stage, data)`` mesh; docs/pipeline.md has the schedule
+    diagram and the bubble/byte cost model).
+
+    The default (no PipelineConfig at all — Config.pipeline is None)
+    keeps every trainer on the existing data-parallel paths.
+    Constructing one (--pipeline-stages / PCNN_PIPELINE_STAGES) opts the
+    zoo trainer into the pipelined step.  stages=1 is the degenerate
+    pipeline: it delegates structurally to the explicit-ring
+    data-parallel step and is bit-exact with it by construction.
+    """
+
+    # Number of pipeline stages S — the size of the mesh's ``stage``
+    # axis.  Device count must be divisible by S; the remaining devices
+    # form the data axis (n_devices // S data-parallel replicas per
+    # stage).
+    stages: int = 1
+    # Manual stage boundaries: comma-separated layer indices at which a
+    # new stage STARTS (e.g. "8,15" for 3 stages of a 23-layer model).
+    # Empty = automatic flops-balanced split from the cost model's
+    # per-layer tables (parallel/pipeline.py split_layers).
+    split: str = ""
+    # Inter-stage activation payload dtype on the wire: "float32"
+    # (exact) or "bfloat16" (half the stage-boundary ICI bytes; the
+    # backward cotangent wire narrows identically).
+    wire_dtype: str = "float32"
+    # Stage-compute activation dtype: "float32", or "bfloat16" for
+    # MXU-native stage math over f32 master params (grads come back
+    # f32; same cast discipline as the fused step's bf16 path).
+    act_dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.stages < 1:
+            raise ValueError(f"stages must be >= 1, got {self.stages}")
+        if self.wire_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"unknown pipeline wire dtype {self.wire_dtype!r} "
+                "(float32 or bfloat16)"
+            )
+        if self.act_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"unknown pipeline act dtype {self.act_dtype!r} "
+                "(float32 or bfloat16)"
+            )
+        self.boundaries()  # validate the split grammar eagerly
+
+    def boundaries(self) -> tuple:
+        """The parsed manual split: sorted stage-start layer indices,
+        () when split is empty (→ automatic balancing)."""
+        out = []
+        for part in filter(None, self.split.split(",")):
+            if not part.strip().isdigit() or int(part) < 1:
+                raise ValueError(
+                    f"bad pipeline split entry {part!r} (want positive "
+                    "layer indices, e.g. '8,15' for 3 stages)"
+                )
+            out.append(int(part))
+        if len(set(out)) != len(out):
+            raise ValueError(
+                f"pipeline split {self.split!r} repeats a boundary"
+            )
+        if out and len(out) != self.stages - 1:
+            raise ValueError(
+                f"pipeline split {self.split!r} names {len(out)} "
+                f"boundaries but stages={self.stages} needs "
+                f"{self.stages - 1}"
+            )
+        return tuple(sorted(out))
+
+    @staticmethod
+    def from_env() -> Optional["PipelineConfig"]:
+        """PipelineConfig from PCNN_PIPELINE_STAGES /
+        PCNN_PIPELINE_SPLIT / PCNN_PIPELINE_WIRE_DTYPE /
+        PCNN_PIPELINE_ACT_DTYPE, or None when none of them is set
+        (→ the historical data-parallel paths)."""
+        stages = os.environ.get("PCNN_PIPELINE_STAGES")
+        split = os.environ.get("PCNN_PIPELINE_SPLIT")
+        wire = os.environ.get("PCNN_PIPELINE_WIRE_DTYPE")
+        act = os.environ.get("PCNN_PIPELINE_ACT_DTYPE")
+        if (stages is None and split is None and wire is None
+                and act is None):
+            return None
+        return PipelineConfig(
+            stages=int(stages) if stages else 1,
+            split=split or "",
+            wire_dtype=wire or "float32",
+            act_dtype=act or "float32",
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
@@ -680,6 +773,10 @@ class Config:
     # into the straggler-tolerant bounded-staleness / EASGD data-parallel
     # modes (train/async_dp.py).
     async_dp: Optional[AsyncConfig] = None
+    # None = data-parallel only; a PipelineConfig opts the zoo trainer
+    # into 1F1B microbatch pipelining over a (stage, data) mesh
+    # (parallel/pipeline.py + train/pipeline_schedule.py).
+    pipeline: Optional[PipelineConfig] = None
     model: str = "lenet_ref"
 
     def replace(self, **kw) -> "Config":

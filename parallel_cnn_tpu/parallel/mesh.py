@@ -33,6 +33,12 @@ MODEL_AXIS = "model"
 # collective (collectives.hier_all_reduce) rings each axis separately so
 # inter-host wires carry only 1/n_dev of the payload.
 HOST_AXIS = "host"
+# Pipeline-parallel (stage, data) meshes: the outer axis over which model
+# layers are partitioned into stages. Built by make_pipeline_mesh; the
+# 1F1B schedule (train/pipeline_schedule.py) moves activations stage→stage
+# and cotangents stage←stage with full-ring ppermutes, while gradients
+# still reduce over the inner data axis with the existing collectives.
+STAGE_AXIS = "stage"
 
 
 def _resolve_shard_map():
@@ -122,6 +128,41 @@ def make_hier_mesh(n_hosts: Optional[int] = None,
         )
     dev_array = np.array(devices).reshape(n_hosts, n // n_hosts)
     return Mesh(dev_array, (HOST_AXIS, DATA_AXIS))
+
+
+def make_pipeline_mesh(n_stages: int,
+                       devices: Optional[Sequence] = None) -> Mesh:
+    """Build the 2-level (stage, data) mesh for pipeline parallelism.
+
+    The device list splits into ``n_stages`` equal contiguous rows; row s
+    holds stage s's layers replicated over the row (the inner ``data``
+    axis — n // n_stages data-parallel replicas per stage). Inter-stage
+    activation/cotangent wires are ppermutes over the stage axis between
+    same-data-index devices; gradient reduction stays on the data axis.
+
+    Device order is normalized to (process_index, id) — the same
+    normalization make_hier_mesh applies — so the same mesh is
+    constructed on every participating process.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    devices.sort(key=lambda d: (d.process_index, getattr(d, "id", 0)))
+    n = len(devices)
+    if n_stages < 1 or n % n_stages != 0:
+        raise ValueError(
+            f"stage axis {n_stages} does not divide device count {n}"
+        )
+    dev_array = np.array(devices).reshape(n_stages, n // n_stages)
+    return Mesh(dev_array, (STAGE_AXIS, DATA_AXIS))
+
+
+def pipeline_axis_sizes(mesh: Mesh):
+    """(n_stages, n_data) of a make_pipeline_mesh mesh."""
+    if STAGE_AXIS not in mesh.axis_names:
+        raise ValueError(
+            f"mesh {mesh.axis_names} has no {STAGE_AXIS!r} axis — build "
+            "it with make_pipeline_mesh"
+        )
+    return mesh.shape[STAGE_AXIS], mesh.shape[DATA_AXIS]
 
 
 def make_elastic_mesh(world: int, *, n_hosts: int = 1,

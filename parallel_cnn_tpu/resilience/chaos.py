@@ -49,7 +49,7 @@ tests/test_resilience.py drives training through it end-to-end. Faults:
 The full CLI spec grammar (``_GRAMMAR`` below, consumed by
 ``from_spec``): ``nan@STEP`` | ``kill@EPOCH`` | ``kill9@EPOCH`` |
 ``resize@STEP:±K`` | ``kill-replica@SEQ`` | ``slow-replica@SEQ:MS`` |
-``slow-worker@STEP:MS``.
+``slow-worker@STEP:MS`` | ``slow-stage@STEP:MS``.
 
 No wall clocks, no unseeded randomness — a chaos run replays exactly.
 """
@@ -76,6 +76,7 @@ SPEC_KINDS: Tuple[str, ...] = (
     "kill-replica@SEQ",
     "slow-replica@SEQ:MS",
     "slow-worker@STEP:MS",
+    "slow-stage@STEP:MS",
 )
 
 _GRAMMAR = "expected " + ", ".join(SPEC_KINDS[:-1]) + f" or {SPEC_KINDS[-1]}"
@@ -112,6 +113,7 @@ class ChaosMonkey:
         kill_replica_seq: Optional[int] = None,
         slow_replica: Optional[Tuple[int, float]] = None,
         slow_worker: Optional[Tuple[int, float]] = None,
+        slow_stage: Optional[Tuple[int, float]] = None,
     ):
         self.nan_step = nan_step
         self.kill_epoch = kill_epoch
@@ -129,6 +131,10 @@ class ChaosMonkey:
         # `step` stalls `ms` milliseconds (train/async_dp.py polls
         # slow_worker_at at the microbatch dispatch boundary).
         self.slow_worker = slow_worker
+        # (step, ms): the pipelined trainer dispatching optimizer step
+        # `step` stalls `ms` milliseconds at a stage boundary
+        # (train/zoo.py polls slow_stage_at before the step dispatch).
+        self.slow_stage = slow_stage
         self.steps_seen = 0
         self.nan_fired = False
         self.kill_fired = False
@@ -136,6 +142,7 @@ class ChaosMonkey:
         self.kill_replica_fired = False
         self.slow_replica_fired = False
         self.slow_worker_fired = False
+        self.slow_stage_fired = False
 
     def after_step(self, tree: Any, loss: Any) -> Tuple[Any, Any]:
         """Post-step hook: returns (possibly poisoned) (tree, loss)."""
@@ -210,6 +217,19 @@ class ChaosMonkey:
             return self.slow_worker[1]
         return None
 
+    def slow_stage_at(self, step: int) -> Optional[float]:
+        """Dispatch hook (pipelined trainer): the stage-boundary stall
+        in milliseconds, exactly once, for the trainer dispatching
+        optimizer step ``step``; None otherwise."""
+        if (
+            self.slow_stage is not None
+            and not self.slow_stage_fired
+            and step >= self.slow_stage[0]
+        ):
+            self.slow_stage_fired = True
+            return self.slow_stage[1]
+        return None
+
     @classmethod
     def from_spec(cls, spec: str) -> "ChaosMonkey":
         """Parse a CLI fault spec (full grammar in ``SPEC_KINDS``):
@@ -217,12 +237,14 @@ class ChaosMonkey:
         ``resize@STEP:±K`` (elastic world-size delta at step STEP),
         ``kill-replica@SEQ`` (serve replica death at dispatched batch
         SEQ), ``slow-replica@SEQ:MS`` (serve replica stalls MS ms at
-        dispatched batch SEQ), or ``slow-worker@STEP:MS`` (training
-        worker stalls MS ms dispatching gradient step STEP)."""
+        dispatched batch SEQ), ``slow-worker@STEP:MS`` (training
+        worker stalls MS ms dispatching gradient step STEP), or
+        ``slow-stage@STEP:MS`` (pipelined trainer stalls MS ms at a
+        stage boundary dispatching optimizer step STEP)."""
         kind, sep, arg = spec.partition("@")
         if not sep or not arg:
             raise ValueError(f"bad chaos spec {spec!r}; {_GRAMMAR}")
-        if kind in ("slow-replica", "slow-worker"):
+        if kind in ("slow-replica", "slow-worker", "slow-stage"):
             seq, ssep, ms = arg.partition(":")
             try:
                 if not ssep:
@@ -232,6 +254,8 @@ class ChaosMonkey:
                     raise ValueError(arg)
                 if kind == "slow-worker":
                     return cls(slow_worker=(int(seq), delay))
+                if kind == "slow-stage":
+                    return cls(slow_stage=(int(seq), delay))
                 return cls(slow_replica=(int(seq), delay))
             except ValueError:
                 raise ValueError(

@@ -31,7 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from parallel_cnn_tpu import obs as obs_lib
 from parallel_cnn_tpu.nn.core import Module
-from parallel_cnn_tpu.parallel.mesh import DATA_AXIS, HOST_AXIS
+from parallel_cnn_tpu.parallel.mesh import DATA_AXIS, HOST_AXIS, STAGE_AXIS
 
 
 @jax.tree_util.register_dataclass
@@ -1227,6 +1227,7 @@ def train(
     chaos=None,
     obs: Optional["obs_lib.Obs"] = None,
     elastic=None,
+    pipeline=None,
 ):
     """Epoch driver for zoo models on an in-memory dataset.
 
@@ -1317,6 +1318,21 @@ def train(
       the next epoch boundary (the epoch's batch generator is fixed-size
       mid-epoch).
 
+    - ``pipeline`` (a config.PipelineConfig; requires a
+      mesh.make_pipeline_mesh (stage, data) mesh): 1F1B microbatch
+      pipelining (train/pipeline_schedule.py) — model layers partition
+      over the stage axis by the cost-model splitter, activations and
+      cotangents move through full-ring stage ppermutes, gradients
+      still reduce over the data axis with the explicit collectives.
+      ``accum_steps`` is the microbatch count M. Composes with the
+      ZeRO-2 fused tail (``fused.update``, zero=2); excludes
+      model_axis, augment, elastic/ZeRO-3, and the fused bf16 loss
+      tail (bf16 stage compute is ``pipeline.act_dtype`` instead). A
+      chaos ``slow-stage@STEP:MS`` spec stalls the trainer once at the
+      step-STEP dispatch boundary (journaled ``chaos_slow_stage``) —
+      the 1F1B schedule is a synchronous tick rendezvous, so one slow
+      stage stretches the whole pipeline's step.
+
     Returns (ZooState, list of per-epoch mean losses).
     """
     if loader not in ("device", "native"):
@@ -1364,6 +1380,34 @@ def train(
             )
     use_fused_update = fused is not None and fused.update
     use_zero3 = use_fused_update and fused.zero == 3
+    if pipeline is not None:
+        if mesh is None or STAGE_AXIS not in mesh.axis_names:
+            raise ValueError(
+                "pipeline training requires a (stage, data) mesh — "
+                "build it with mesh.make_pipeline_mesh(pipeline.stages)"
+            )
+        if model_axis:
+            raise ValueError(
+                "pipeline partitions layers over the stage axis; "
+                "model_axis filter sharding stays on the GSPMD path "
+                "(drop one of the two)"
+            )
+        if augment:
+            raise ValueError(
+                "pipeline training does not thread augmentation keys "
+                "through the 1F1B schedule yet — drop --augment"
+            )
+        if use_zero3:
+            raise ValueError(
+                "pipeline composes with ZeRO-2 only: ZeRO-3's just-in-"
+                "time head gathers contradict per-stage param residency "
+                "(docs/pipeline.md)"
+            )
+        if fused is not None and not use_fused_update:
+            # The fused bf16/tail refinements ride _build_loss_fn, which
+            # the per-stage schedule replaces; bf16 stage compute is
+            # pipeline.act_dtype instead.
+            fused = None
     if elastic is not None and elastic.enabled and not use_zero3:
         raise ValueError(
             "elastic training requires the ZeRO-3 step (fused.zero=3 "
@@ -1401,7 +1445,20 @@ def train(
         def aug_fn(key, x):
             return aug_lib.random_crop_flip(key, x, pad=augment_pad)
 
-    if use_zero3:
+    if pipeline is not None:
+        from parallel_cnn_tpu.train.pipeline_schedule import (
+            make_pipeline_step,
+        )
+
+        step = make_pipeline_step(
+            model,
+            None if use_fused_update else optimizer,
+            accum_steps=accum_steps, mesh=mesh, pipeline=pipeline,
+            in_shape=in_shape, comm=comm,
+            fused=fused if use_fused_update else None,
+            lr=lr, momentum=momentum,
+        )
+    elif use_zero3:
         step = make_zero3_train_step(
             model, lr=lr, momentum=momentum, accum_steps=accum_steps,
             mesh=mesh, augment=aug_fn, comm=comm, fused=fused,
@@ -1640,6 +1697,14 @@ def train(
                 if aug_fn is not None
                 else None
             )
+            if chaos is not None and pipeline is not None:
+                _stall = chaos.slow_stage_at(opt_steps)
+                if _stall is not None:
+                    time.sleep(_stall / 1000.0)
+                    if obs.enabled:
+                        obs.event(
+                            "chaos_slow_stage", step=opt_steps, ms=_stall
+                        )
             with obs.span("zoo.dispatch", cat="step"):
                 state, loss = step(
                     state, jnp.asarray(bx), jnp.asarray(by), key

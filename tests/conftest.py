@@ -118,3 +118,10 @@ def pytest_configure(config):
         "convergence, slow-worker chaos, sentinel drop, decorrelated "
         "retry jitter)",
     )
+    config.addinivalue_line(
+        "markers",
+        "pipeline: pipeline-parallel tests (parallel/pipeline.py, "
+        "train/pipeline_schedule.py — 1F1B schedule determinism, stash "
+        "bound, cost-model splitter, stages=1 bit-exactness, multi-stage "
+        "loss parity, ZeRO-2/bf16 composition, slow-stage chaos grammar)",
+    )

@@ -1,0 +1,467 @@
+"""Pipeline parallelism (parallel/pipeline.py, train/pipeline_schedule.py).
+
+Covers the 1F1B schedule's closed-form event table (determinism, the
+2(M+S−1) tick count, disjoint fwd/bwd tick parity, the ≤S activation-stash
+bound), the cost-model-driven stage splitter (balance against the
+per-layer flops tables, manual-boundary override, grammar rejects), the
+step itself (stages=1 bit-exact vs the flat data ring; stages 2/4 seeded
+3-step loss parity ≤1e-5; composition with the ZeRO-2 fused tail and with
+bf16 wire/activations), the PipelineConfig env/flag surface, the
+`slow-stage@STEP:MS` chaos grammar, and the zoo.train validation fences.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from parallel_cnn_tpu.config import (
+    CommConfig, FusedStepConfig, MeshConfig, PipelineConfig,
+)
+from parallel_cnn_tpu.nn import layers as L
+from parallel_cnn_tpu.nn.core import Sequential
+from parallel_cnn_tpu.parallel import pipeline as pp
+from parallel_cnn_tpu.parallel import mesh as mesh_lib
+from parallel_cnn_tpu.resilience.chaos import SPEC_KINDS, ChaosMonkey
+from parallel_cnn_tpu.train import zoo
+from parallel_cnn_tpu.train.pipeline_schedule import (
+    make_pipeline_step, stage_plan,
+)
+
+pytestmark = pytest.mark.pipeline
+
+IN_SHAPE = (8, 8, 3)
+
+
+def small_model():
+    return Sequential([
+        L.Conv2D(4, (3, 3)), L.BatchNorm(), L.ReLU(), L.MaxPool(),
+        L.Conv2D(8, (3, 3)), L.ReLU(), L.Flatten(), L.Dense(10),
+    ])
+
+
+# ---------------------------------------------------------------------------
+# Schedule: closed-form 1F1B event table
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("s,m", [(1, 1), (1, 4), (2, 2), (2, 4), (4, 2),
+                                 (4, 8), (8, 3)])
+def test_schedule_closed_form(s, m):
+    events = pp.schedule_events(s, m)
+    assert len(events) == pp.n_ticks(s, m) == 2 * (m + s - 1)
+    # Determinism: the table is a pure function of (S, M).
+    assert events == pp.schedule_events(s, m)
+    fwd_done = [set() for _ in range(s)]
+    bwd_done = [set() for _ in range(s)]
+    for t, ev in enumerate(events):
+        for st in range(s):
+            f, b = ev.fwd[st], ev.bwd[st]
+            # One unit of work per stage per tick, never both.
+            assert f is None or b is None
+            if f is not None:
+                # Microbatch f's forward reaches stage st only after
+                # stage st-1 ran it (one-tick wire latency).
+                if st > 0:
+                    assert f in fwd_done[st - 1]
+                fwd_done[st].add(f)
+            if b is not None:
+                # Backward enters at the LAST stage after its forward,
+                # then chains downward.
+                if st == s - 1:
+                    assert b in fwd_done[st]
+                else:
+                    assert b in bwd_done[st + 1]
+                bwd_done[st].add(b)
+    # Every microbatch completes both passes on every stage.
+    for st in range(s):
+        assert fwd_done[st] == bwd_done[st] == set(range(m))
+
+
+@pytest.mark.parametrize("s,m", [(2, 2), (4, 2), (4, 8), (8, 3)])
+def test_schedule_arrays_match_events(s, m):
+    events = pp.schedule_events(s, m)
+    fm, fv, bm, bv = pp.schedule_arrays(s, m)
+    assert fm.shape == fv.shape == bm.shape == bv.shape == (len(events), s)
+    for t, ev in enumerate(events):
+        for st in range(s):
+            assert bool(fv[t, st]) == (ev.fwd[st] is not None)
+            if ev.fwd[st] is not None:
+                assert fm[t, st] == ev.fwd[st]
+            assert bool(bv[t, st]) == (ev.bwd[st] is not None)
+            if ev.bwd[st] is not None:
+                assert bm[t, st] == ev.bwd[st]
+
+
+@pytest.mark.parametrize("s,m", [(1, 1), (2, 2), (2, 8), (4, 2), (4, 4),
+                                 (8, 3)])
+def test_stash_high_water_bounded(s, m):
+    # The 1F1B point: at most S microbatches live per stage, however
+    # large M grows.
+    assert pp.stash_high_water(s, m) <= s
+
+
+@pytest.mark.parametrize("s,m", [(1, 4), (2, 4), (4, 4), (4, 2)])
+def test_bubble_fraction(s, m):
+    fm, fv, bm, bv = pp.schedule_arrays(s, m)
+    ticks = pp.n_ticks(s, m)
+    counted = 1.0 - (int(fv.sum()) + int(bv.sum())) / (ticks * s)
+    assert counted == pytest.approx(pp.bubble_fraction(s, m), abs=1e-12)
+    assert pp.bubble_fraction(s, m) == pytest.approx(
+        (s - 1) / (s - 1 + m), abs=1e-12
+    )
+
+
+def test_schedule_rejects_bad_sizes():
+    with pytest.raises(ValueError):
+        pp.schedule_events(0, 4)
+    with pytest.raises(ValueError):
+        pp.schedule_events(2, 0)
+
+
+# ---------------------------------------------------------------------------
+# Splitter: cost tables → balanced boundaries
+# ---------------------------------------------------------------------------
+
+def test_layer_costs_shapes_and_flops():
+    model = small_model()
+    costs = pp.layer_costs(model, IN_SHAPE, microbatch=1)
+    assert len(costs) == len(model.layers)
+    # Conv layers dominate; activation-only layers are flop-free in the
+    # dot/conv accounting.
+    assert costs[0].flops > 0 and costs[4].flops > 0
+    assert costs[2].flops == 0  # ReLU
+    # Shapes thread: flatten feeds the dense layer's in-features.
+    assert costs[-2].out_shape == (1, costs[-2].out_numel)
+
+
+@pytest.mark.parametrize("n_stages", [2, 4])
+def test_split_layers_balances_flops(n_stages):
+    model = small_model()
+    costs = pp.layer_costs(model, IN_SHAPE, microbatch=1)
+    flops = [c.flops for c in costs]
+    bounds = pp.split_layers(model, n_stages, IN_SHAPE)
+    assert len(bounds) == n_stages - 1
+    assert bounds == tuple(sorted(bounds))
+
+    def stage_max(bs):
+        edges = (0, *bs, len(flops))
+        return max(
+            sum(flops[a:b]) for a, b in zip(edges, edges[1:])
+        )
+
+    # The DP's max-stage-flops is minimal over every legal split.
+    import itertools
+    best = min(
+        stage_max(c)
+        for c in itertools.combinations(range(1, len(flops)), n_stages - 1)
+    )
+    assert stage_max(bounds) == best
+
+
+def test_split_layers_manual_override_and_rejects():
+    model = small_model()
+    assert pp.split_layers(model, 2, IN_SHAPE, boundaries=(3,)) == (3,)
+    with pytest.raises(ValueError):
+        pp.split_layers(model, 2, IN_SHAPE, boundaries=(0,))  # empty stage
+    with pytest.raises(ValueError):
+        pp.split_layers(model, 2, IN_SHAPE, boundaries=(3, 5))  # count
+    with pytest.raises(ValueError):
+        pp.split_layers(model, 9, IN_SHAPE)  # more stages than layers
+
+
+def test_stage_plan_matches_split():
+    model = small_model()
+    cfg = PipelineConfig(stages=2)
+    bounds, assign, flops = stage_plan(model, cfg, IN_SHAPE)
+    assert bounds == pp.split_layers(model, 2, IN_SHAPE)
+    assert len(assign) == len(model.layers)
+    assert len(flops) == 2
+    # Assignment is the boundary structure, layer by layer.
+    assert [int(a) for a in assign] == [
+        0 if i < bounds[0] else 1 for i in range(len(model.layers))
+    ]
+
+
+def test_pack_unpack_roundtrip():
+    x = jnp.arange(24.0).reshape(2, 3, 4)
+    buf = pp.pack_acts(x, 20)
+    assert buf.shape == (2, 20)
+    assert jnp.array_equal(pp.unpack_acts(buf, (2, 3, 4)), x)
+
+
+# ---------------------------------------------------------------------------
+# PipelineConfig surface
+# ---------------------------------------------------------------------------
+
+def test_pipeline_config_validation():
+    assert PipelineConfig().stages == 1
+    assert PipelineConfig(stages=3, split="5,2").boundaries() == (2, 5)
+    with pytest.raises(ValueError):
+        PipelineConfig(stages=0)
+    with pytest.raises(ValueError):
+        PipelineConfig(stages=2, wire_dtype="float16")
+    with pytest.raises(ValueError):
+        PipelineConfig(stages=2, act_dtype="int8")
+    with pytest.raises(ValueError):
+        PipelineConfig(stages=2, split="3,3")  # repeated boundary
+    with pytest.raises(ValueError):
+        PipelineConfig(stages=2, split="x")
+
+
+def test_pipeline_config_from_env(monkeypatch):
+    for var in ("PCNN_PIPELINE_STAGES", "PCNN_PIPELINE_SPLIT",
+                "PCNN_PIPELINE_WIRE_DTYPE", "PCNN_PIPELINE_ACT_DTYPE"):
+        monkeypatch.delenv(var, raising=False)
+    assert PipelineConfig.from_env() is None
+    monkeypatch.setenv("PCNN_PIPELINE_STAGES", "4")
+    monkeypatch.setenv("PCNN_PIPELINE_WIRE_DTYPE", "bfloat16")
+    cfg = PipelineConfig.from_env()
+    assert cfg == PipelineConfig(stages=4, wire_dtype="bfloat16")
+
+
+# ---------------------------------------------------------------------------
+# The step: parity against the flat data ring
+# ---------------------------------------------------------------------------
+
+ACCUM, BATCH, STEPS = 2, 32, 3
+
+
+@pytest.fixture(scope="module")
+def pipe_data():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(STEPS, BATCH, *IN_SHAPE)).astype(np.float32)
+    Y = rng.integers(0, 10, size=(STEPS, BATCH)).astype(np.int32)
+    return X, Y
+
+
+def _run(step_fn, mesh, model, X, Y):
+    opt = zoo.make_optimizer(lr=0.1, momentum=0.9)
+    st = mesh_lib.replicate(
+        mesh, zoo.init_state(model, jax.random.PRNGKey(7), IN_SHAPE, opt)
+    )
+    losses = []
+    for i in range(STEPS):
+        st, loss = step_fn(st, jnp.asarray(X[i]), jnp.asarray(Y[i]))
+        losses.append(float(loss))
+    return losses, st
+
+
+def _ring_baseline(model, n_data, X, Y):
+    mesh = mesh_lib.make_mesh(
+        MeshConfig(data=n_data, model=1), devices=jax.devices()[:n_data]
+    )
+    step = zoo.make_train_step(
+        model, zoo.make_optimizer(lr=0.1, momentum=0.9),
+        accum_steps=ACCUM, mesh=mesh, comm=CommConfig(impl="ring"),
+    )
+    return _run(step, mesh, model, X, Y)[0]
+
+
+def test_stages1_bit_exact(host_devices, pipe_data):
+    X, Y = pipe_data
+    model = small_model()
+    pmesh = mesh_lib.make_pipeline_mesh(1)
+    step = make_pipeline_step(
+        model, zoo.make_optimizer(lr=0.1, momentum=0.9),
+        accum_steps=ACCUM, mesh=pmesh, pipeline=PipelineConfig(stages=1),
+        in_shape=IN_SHAPE, comm=CommConfig(impl="ring"),
+    )
+    pl, _ = _run(step, pmesh, model, X, Y)
+    assert pl == _ring_baseline(model, 8, X, Y)
+
+
+@pytest.mark.parametrize("n_stages", [2, 4])
+def test_multi_stage_loss_parity(host_devices, pipe_data, n_stages):
+    X, Y = pipe_data
+    model = small_model()
+    pmesh = mesh_lib.make_pipeline_mesh(n_stages)
+    step = make_pipeline_step(
+        model, zoo.make_optimizer(lr=0.1, momentum=0.9),
+        accum_steps=ACCUM, mesh=pmesh,
+        pipeline=PipelineConfig(stages=n_stages),
+        in_shape=IN_SHAPE, comm=CommConfig(impl="ring"),
+    )
+    pl, _ = _run(step, pmesh, model, X, Y)
+    bl = _ring_baseline(model, 8 // n_stages, X, Y)
+    assert max(abs(a - b) for a, b in zip(pl, bl)) <= 1e-5
+
+
+def test_bf16_wire_and_act_composition(host_devices, pipe_data):
+    X, Y = pipe_data
+    model = small_model()
+    pmesh = mesh_lib.make_pipeline_mesh(2)
+    step = make_pipeline_step(
+        model, zoo.make_optimizer(lr=0.1, momentum=0.9),
+        accum_steps=ACCUM, mesh=pmesh,
+        pipeline=PipelineConfig(stages=2, wire_dtype="bfloat16",
+                                act_dtype="bfloat16"),
+        in_shape=IN_SHAPE, comm=CommConfig(impl="ring"),
+    )
+    pl, _ = _run(step, pmesh, model, X, Y)
+    bl = _ring_baseline(model, 4, X, Y)
+    # Same tolerance contract as the fused bf16 gate.
+    assert max(abs(a - b) for a, b in zip(pl, bl)) <= 1e-2
+
+
+def test_zero2_fused_composition(host_devices, pipe_data):
+    X, Y = pipe_data
+    model = small_model()
+    n_stages, n_data = 2, 4
+    comm = CommConfig(impl="ring")
+    fused = FusedStepConfig(update=True, tail=False, act_dtype="float32")
+    pmesh = mesh_lib.make_pipeline_mesh(n_stages)
+    step = make_pipeline_step(
+        model, None, accum_steps=ACCUM, mesh=pmesh,
+        pipeline=PipelineConfig(stages=n_stages), in_shape=IN_SHAPE,
+        comm=comm, fused=fused, lr=0.1, momentum=0.9,
+    )
+    st, _ = zoo.init_fused_state(
+        model, jax.random.PRNGKey(7), IN_SHAPE, n_data=n_data,
+        fused=fused, bucket_bytes=comm.bucket_bytes,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    st = zoo.ZooState(
+        params=jax.device_put(st.params, NamedSharding(pmesh, P())),
+        model_state=jax.device_put(
+            st.model_state, NamedSharding(pmesh, P())
+        ),
+        opt_state=zoo.FusedOptState(
+            mom=[
+                jax.device_put(m, NamedSharding(pmesh, P("data")))
+                for m in st.opt_state.mom
+            ],
+            scale=jax.device_put(st.opt_state.scale,
+                                 NamedSharding(pmesh, P())),
+            good_steps=jax.device_put(st.opt_state.good_steps,
+                                      NamedSharding(pmesh, P())),
+            skipped=jax.device_put(st.opt_state.skipped,
+                                   NamedSharding(pmesh, P())),
+        ),
+    )
+    losses = []
+    for i in range(STEPS):
+        st, loss = step(st, jnp.asarray(X[i]), jnp.asarray(Y[i]))
+        losses.append(float(loss))
+    bl = _ring_baseline(model, n_data, X, Y)
+    assert max(abs(a - b) for a, b in zip(losses, bl)) <= 1e-5
+
+
+# ---------------------------------------------------------------------------
+# Validation fences
+# ---------------------------------------------------------------------------
+
+def test_make_pipeline_step_rejects(host_devices):
+    model = small_model()
+    pmesh = mesh_lib.make_pipeline_mesh(2)
+    opt = zoo.make_optimizer(lr=0.1, momentum=0.9)
+    # ZeRO-3 contradicts per-stage param residency.
+    with pytest.raises(ValueError, match="ZeRO"):
+        make_pipeline_step(
+            model, None, accum_steps=2, mesh=pmesh,
+            pipeline=PipelineConfig(stages=2), in_shape=IN_SHAPE,
+            fused=FusedStepConfig(update=True, zero=3),
+        )
+    # Mesh stage axis must match pipeline.stages.
+    with pytest.raises(ValueError, match="stage"):
+        make_pipeline_step(
+            model, opt, accum_steps=2, mesh=pmesh,
+            pipeline=PipelineConfig(stages=4), in_shape=IN_SHAPE,
+        )
+    # stages=1 has no fused delegate.
+    with pytest.raises(ValueError):
+        make_pipeline_step(
+            model, None, accum_steps=2,
+            mesh=mesh_lib.make_pipeline_mesh(1),
+            pipeline=PipelineConfig(stages=1), in_shape=IN_SHAPE,
+            fused=FusedStepConfig(update=True, zero=2),
+        )
+
+
+def test_zoo_train_pipeline_fences(host_devices):
+    model = small_model()
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(16, *IN_SHAPE)).astype(np.float32)
+    Y = rng.integers(0, 10, size=(16,)).astype(np.int32)
+    # No (stage, data) mesh → refused.
+    with pytest.raises(ValueError, match="stage"):
+        zoo.train(model, X, Y, in_shape=IN_SHAPE, epochs=1, batch_size=8,
+                  pipeline=PipelineConfig(stages=2))
+    # model_axis and ZeRO-3 are fenced off explicitly.
+    pmesh = mesh_lib.make_pipeline_mesh(2)
+    with pytest.raises(ValueError, match="model_axis"):
+        zoo.train(model, X, Y, in_shape=IN_SHAPE, epochs=1, batch_size=8,
+                  mesh=pmesh, model_axis=True,
+                  pipeline=PipelineConfig(stages=2))
+    with pytest.raises(ValueError, match="ZeRO"):
+        zoo.train(model, X, Y, in_shape=IN_SHAPE, epochs=1, batch_size=8,
+                  mesh=pmesh, comm=CommConfig(impl="ring"),
+                  fused=FusedStepConfig(update=True, zero=3),
+                  pipeline=PipelineConfig(stages=2))
+
+
+def test_mesh_helpers(host_devices):
+    pmesh = mesh_lib.make_pipeline_mesh(2)
+    assert mesh_lib.pipeline_axis_sizes(pmesh) == (2, 4)
+    flat = mesh_lib.make_mesh(MeshConfig(data=8, model=1))
+    with pytest.raises(ValueError):
+        mesh_lib.pipeline_axis_sizes(flat)
+    with pytest.raises(ValueError):
+        mesh_lib.make_pipeline_mesh(3)  # 8 % 3 != 0
+
+
+# ---------------------------------------------------------------------------
+# Chaos grammar: slow-stage@STEP:MS
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_slow_stage_grammar():
+    assert "slow-stage@STEP:MS" in SPEC_KINDS
+    m = ChaosMonkey.from_spec("slow-stage@2:250")
+    assert m.slow_stage == (2, 250.0)
+    # One-shot: fires at the first step >= STEP, then never again.
+    assert m.slow_stage_at(1) is None
+    assert m.slow_stage_at(2) == 250.0
+    assert m.slow_stage_fired
+    assert m.slow_stage_at(3) is None
+    with pytest.raises(ValueError):
+        ChaosMonkey.from_spec("slow-stage@2")  # missing :MS
+    with pytest.raises(ValueError):
+        ChaosMonkey.from_spec("slow-stage@x:5")
+
+
+@pytest.mark.chaos
+def test_slow_stage_journaled(host_devices, tmp_path):
+    from parallel_cnn_tpu import obs as obs_lib
+    from parallel_cnn_tpu.config import ObsConfig
+
+    model = Sequential([
+        L.Conv2D(4, (3, 3)), L.ReLU(), L.MaxPool(),
+        L.Flatten(), L.Dense(10),
+    ])
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(32, *IN_SHAPE)).astype(np.float32)
+    Y = rng.integers(0, 10, size=(32,)).astype(np.int32)
+    chaos = ChaosMonkey.from_spec("slow-stage@1:1")
+    bundle = obs_lib.from_config(
+        ObsConfig(dir=str(tmp_path)), run="test"
+    )
+    zoo.train(
+        model, X, Y, in_shape=IN_SHAPE, epochs=1, batch_size=16,
+        accum_steps=2, mesh=mesh_lib.make_pipeline_mesh(2),
+        comm=CommConfig(impl="ring"),
+        pipeline=PipelineConfig(stages=2), chaos=chaos, obs=bundle,
+        seed=7,
+    )
+    artifacts = bundle.finish()
+    assert chaos.slow_stage_fired
+    import json
+    journal = artifacts.get("journal")
+    assert journal, f"no journal artifact in {artifacts}"
+    events = [
+        json.loads(line)
+        for line in open(journal).read().splitlines()
+    ]
+    assert any(e.get("kind") == "chaos_slow_stage" for e in events)
