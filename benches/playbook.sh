@@ -45,6 +45,14 @@
 #             schedule-counted bubble fraction equal to the closed form
 #             (S-1)/(S-1+M). CPU-only and self-contained — gates commits
 #             like comm-multihost; PIPELINE_GATE is the contract line.
+#   net       network front-door gate (benches/run.py --suite net):
+#             cold-vs-warm AOT disk-cache cold start (warm must compile
+#             nothing), wire-vs-in-process throughput, and the net
+#             scenario sweep over real loopback sockets (steady /
+#             slow-loris reap / supervised kill-endpoint respawn /
+#             unsupervised trip / hot-swap zero-failed). CPU-only and
+#             self-contained — gates commits like comm-multihost;
+#             SERVE_NET_GATE is the contract line.
 #   serve-chaos
 #             SLO-guarded serving gate (benches/run.py --suite serve):
 #             seeded scenario suites (diurnal / flash-crowd /
@@ -153,6 +161,24 @@ if [ "$MODE" = "pipeline" ]; then
   # The gate line is the contract: parity (bit-exact / <= 1e-5) + the
   # schedule bubble equal to (S-1)/(S-1+M).
   grep -q 'PIPELINE_GATE PASS' "$OUT" || RC=1
+  [ $RC -ne 0 ] && OVERALL=1
+  echo "=== playbook ${MODE} end rc=${OVERALL} $(date -u +%FT%TZ) ===" >> "$LOG"
+  exit $OVERALL
+fi
+
+if [ "$MODE" = "net" ]; then
+  echo "--- serve network front-door gate ---" >> "$LOG"
+  OUT="docs/serve_net_${TAG}.txt"
+  # 8 virtual devices so the hot-swap leg's grown replica gets its own
+  # device slot (same mesh the tests and the serve suite assume).
+  timeout 900 env JAX_PLATFORMS=cpu PCNN_JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python benches/run.py --quick --suite net > "$OUT" 2>&1
+  RC=$?; echo "net rc=$RC" >> "$LOG"
+  # The gate line is the contract: zero warm-start compiles, balanced
+  # wire ledgers, the loris reaped, the supervised kill ridden through,
+  # the unsupervised trip proven, the hot swap zero-failed.
+  grep -q 'SERVE_NET_GATE PASS' "$OUT" || RC=1
   [ $RC -ne 0 ] && OVERALL=1
   echo "=== playbook ${MODE} end rc=${OVERALL} $(date -u +%FT%TZ) ===" >> "$LOG"
   exit $OVERALL

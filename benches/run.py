@@ -1332,6 +1332,255 @@ def _bench_serve_slo(quick: bool) -> List[Row]:
     return rows
 
 
+def bench_net(quick: bool) -> List[Row]:
+    """--suite net: the network front door behind SERVE_NET_GATE.
+
+    Four measured rows plus the scenario sweep (serve/net.py,
+    serve/supervisor.py — docs/serving.md "Network front door"):
+
+      cold start      serve_stack seconds with the persistent AOT disk
+                      cache empty vs populated; the warm start must
+                      issue ZERO compiles (EngineStats-asserted — the
+                      issue's acceptance line, not just a timing),
+      wire overhead   closed-loop throughput over a loopback socket as
+                      a fraction of the same batcher driven in-process,
+      hot swap        seconds for the grow→drain→retire weight roll
+                      under live socket traffic, failed_delta must be 0,
+      scenarios       net-steady / net-slow-loris (must actually reap) /
+                      net-kill-endpoint (supervised respawn, retries
+                      ride through) judged by their gates, plus the
+                      anti-vacuity control arm: the same kill with the
+                      supervisor disabled must FAIL its gates.
+
+    Any violated expectation appends an error row (rc 1) and flips the
+    contract line to SERVE_NET_GATE FAIL — playbook.sh's net mode greps
+    for it."""
+    import tempfile
+
+    from parallel_cnn_tpu.config import ServeConfig
+    from parallel_cnn_tpu.resilience.chaos import ChaosMonkey
+    from parallel_cnn_tpu.resilience.retry import RetryPolicy
+    from parallel_cnn_tpu.serve import (
+        NetServer, Supervisor, WireStats, get, loadgen, scenarios,
+        serve_stack,
+    )
+    from parallel_cnn_tpu.serve.engine import load_or_init
+
+    handle = get("lenet_ref")
+
+    def cfg(**kw):
+        base = dict(model="lenet_ref", max_batch=8, max_wait_ms=2.0,
+                    queue_depth=256)
+        base.update(kw)
+        return ServeConfig(**base)
+
+    rows: List[Row] = []
+    failures: List[str] = []
+
+    # -- cold start: AOT disk cache cold vs warm -------------------------
+    with tempfile.TemporaryDirectory(prefix="pcnn_aot_bench_") as cdir:
+        t0 = time.perf_counter()
+        pool, batcher = serve_stack(handle, cfg(), cache_dir=cdir)
+        cold_s = time.perf_counter() - t0
+        n_entries = sum(e.stats.aot_cache_misses for e in pool.engines)
+        cold_compiles = sum(e.stats.aot_compiles for e in pool.engines)
+        batcher.close()
+        t0 = time.perf_counter()
+        pool, batcher = serve_stack(handle, cfg(), cache_dir=cdir)
+        warm_s = time.perf_counter() - t0
+        warm_compiles = sum(e.stats.aot_compiles for e in pool.engines)
+        warm_hits = sum(e.stats.aot_cache_hits for e in pool.engines)
+        batcher.close()
+    rows.append(Row(
+        "net_cold_start_cache_cold", round(cold_s, 3), "sec",
+        baseline_src=f"{cold_compiles} compiles, {n_entries} entries "
+                     f"written",
+    ).finish())
+    rows.append(Row(
+        "net_cold_start_cache_warm", round(warm_s, 3), "sec",
+        baseline=round(cold_s, 3),
+        baseline_src=f"cold start above; {warm_compiles} compiles, "
+                     f"{warm_hits} disk hits",
+    ).finish())
+    if cold_compiles == 0 or n_entries == 0:
+        failures.append("cold start issued no compiles / wrote no cache "
+                        "entries (the cold leg is vacuous)")
+    if warm_compiles != 0:
+        failures.append(
+            f"warm cold-start issued {warm_compiles} compiles "
+            "(the acceptance line is ZERO: every bucket must "
+            "deserialize from the disk tier)"
+        )
+    if warm_hits != n_entries:
+        failures.append(
+            f"warm start hit {warm_hits}/{n_entries} disk entries"
+        )
+
+    # -- one long-lived stack for the wire legs --------------------------
+    pool, batcher = serve_stack(handle, cfg())
+    try:
+        samples = scenarios.make_samples(32, handle.in_shape, seed=0)
+        n_req = 96 if quick else 256
+
+        # In-process closed loop vs the identical loop over loopback.
+        inproc = loadgen.run_closed_loop(
+            batcher, samples, n_requests=n_req, concurrency=4, seed=0,
+        )
+        wire = WireStats()
+        srv = NetServer(batcher, wire=wire, conn_deadline_ms=5000.0).start()
+        try:
+            netrep = loadgen.run_closed_loop_net(
+                srv.address, samples, n_requests=n_req, concurrency=4,
+                timeout_s=15.0, seed=0,
+            )
+        finally:
+            srv.close()
+        ratio = (netrep.throughput / inproc.throughput
+                 if inproc.throughput > 0 else 0.0)
+        rows.append(Row(
+            "net_wire_throughput_ratio", round(ratio, 3),
+            "x of in-process",
+            baseline_src=(
+                f"wire {netrep.throughput:.0f} req/s vs in-process "
+                f"{inproc.throughput:.0f} req/s, {n_req} requests x 4 "
+                f"clients, NDJSON over loopback"
+            ),
+        ).finish())
+        if netrep.completed != n_req or inproc.completed != n_req:
+            failures.append(
+                f"throughput legs dropped requests (wire "
+                f"{netrep.completed}/{n_req}, in-process "
+                f"{inproc.completed}/{n_req})"
+            )
+        if not wire.balanced():
+            failures.append(f"throughput leg wire ledger {wire.snapshot()}")
+
+        # -- scenario legs ----------------------------------------------
+        def judge(leg, rep, want_pass=True):
+            p99 = rep.p99_ms
+            rows.append(Row(
+                f"net_{leg}", round(p99, 2) if p99 is not None else -1.0,
+                "ms p99",
+                baseline_src=(
+                    f"{'expected-trip' if not want_pass else 'clean'}, "
+                    f"gates {rep.gates()}, wire {rep.wire}"
+                ),
+            ).finish())
+            if not rep.wire_ok:
+                failures.append(f"{leg}: wire ledger broken {rep.wire}")
+            elif want_pass and not rep.passed:
+                failures.append(f"{leg}: gates {rep.gates()}")
+            elif not want_pass and rep.passed:
+                failures.append(
+                    f"{leg}: PASSED with the supervisor disabled under an "
+                    "armed kill-endpoint — the respawn gate is vacuous"
+                )
+            return rep
+
+        # Clean steady state.
+        wire = WireStats()
+        srv = NetServer(batcher, wire=wire, conn_deadline_ms=5000.0).start()
+        try:
+            judge("steady", scenarios.run_net(
+                "net-steady", batcher, wire=wire, server=srv, seed=0,
+            ))
+        finally:
+            srv.close()
+
+        # Slow loris: the stalled socket must be reaped as expired.
+        wire = WireStats()
+        srv = NetServer(batcher, wire=wire, conn_deadline_ms=150.0).start()
+        try:
+            rep = judge("slow_loris", scenarios.run_net(
+                "net-slow-loris", batcher, wire=wire, server=srv,
+                chaos=ChaosMonkey.from_spec("slow-loris@3:400"), seed=1,
+            ))
+            if rep.wire.get("reaped", 0) < 1:
+                failures.append("slow_loris: the stall never reaped")
+        finally:
+            srv.close()
+
+        # Supervised kill: retries ride through the respawn.
+        wire = WireStats()
+        armed = [ChaosMonkey.from_spec("kill-endpoint@12")]
+
+        def factory(port, seq_start):
+            m = armed.pop(0) if armed else None
+            return NetServer(batcher, port=port, conn_deadline_ms=2000.0,
+                             wire=wire, chaos=m, seq_start=seq_start,
+                             ).start()
+
+        sup = Supervisor(factory, policy=RetryPolicy(
+            attempts=6, base_delay=0.02, max_delay=0.2, seed=0,
+        )).start()
+        try:
+            rep = judge("kill_endpoint_supervised", scenarios.run_net(
+                "net-kill-endpoint", batcher, wire=wire, supervisor=sup,
+                retry=RetryPolicy(attempts=8, base_delay=0.05,
+                                  max_delay=0.5, seed=1),
+            ))
+            if sup.respawns < 1 or sup.gave_up:
+                failures.append(
+                    f"kill_endpoint_supervised: respawns={sup.respawns} "
+                    f"gave_up={sup.gave_up}"
+                )
+        finally:
+            sup.close()
+
+        # Control arm: same fault, supervision off — must trip.
+        wire = WireStats()
+        armed = [ChaosMonkey.from_spec("kill-endpoint@12")]
+        sup = Supervisor(factory, enabled=False).start()
+        try:
+            judge("kill_endpoint_unsupervised_trip", scenarios.run_net(
+                "net-kill-endpoint", batcher, wire=wire, supervisor=sup,
+                retry=RetryPolicy(attempts=3, base_delay=0.01,
+                                  max_delay=0.05, seed=1),
+            ), want_pass=False)
+        finally:
+            sup.close()
+
+        # Hot swap under diurnal load (last: it replaces the weights).
+        wire = WireStats()
+        srv = NetServer(batcher, wire=wire, conn_deadline_ms=5000.0).start()
+        try:
+            new_params, new_state = load_or_init(handle, seed=7)
+            rep = judge("hot_swap_diurnal", scenarios.run_net(
+                "net-hot-swap-diurnal", batcher, wire=wire, server=srv,
+                swap_params=new_params, swap_state=new_state, seed=2,
+            ))
+            swap = rep.swap or {}
+            rows.append(Row(
+                "net_hot_swap_downtime", round(swap.get("seconds", -1.0), 3),
+                "sec",
+                baseline_src=(
+                    f"failed_delta {swap.get('failed_delta')}, swapped "
+                    f"{len(swap.get('swapped', []))}, stuck "
+                    f"{swap.get('stuck')} — grow-drain-retire under live "
+                    f"socket traffic"
+                ),
+            ).finish())
+        finally:
+            srv.close()
+    finally:
+        batcher.close()
+
+    if failures:
+        rows.append(Row(
+            "error_serve_net_gate", -1.0, "error",
+            baseline_src="; ".join(failures),
+        ))
+    print(
+        "SERVE_NET_GATE "
+        + ("PASS: warm cold-start compiled nothing, wire ledger balanced "
+           "in every leg, loris reaped, supervised kill rode through, "
+           "unsupervised trip proven, hot swap zero-failed"
+           if not failures else "FAIL: " + "; ".join(failures)),
+        flush=True,
+    )
+    return rows
+
+
 def bench_cost(quick: bool) -> List[Row]:
     """--suite cost: the static cost accountant next to measured CPU rows.
 
@@ -1941,8 +2190,8 @@ def main(argv=None) -> int:
         "--suite",
         default="all",
         choices=["all", "lenet", "phases", "dp", "zoo", "parity", "ops",
-                 "comm", "northstar", "serve", "fused", "cost", "obs",
-                 "elastic", "pipeline"],
+                 "comm", "northstar", "serve", "net", "fused", "cost",
+                 "obs", "elastic", "pipeline"],
     )
     args = ap.parse_args(argv)
 
@@ -1963,6 +2212,7 @@ def main(argv=None) -> int:
         "comm": bench_comm,
         "northstar": bench_northstar,
         "serve": bench_serve,
+        "net": bench_net,
         "fused": bench_fused,
         "cost": bench_cost,
         "obs": bench_obs,

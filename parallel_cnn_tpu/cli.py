@@ -25,6 +25,7 @@ from parallel_cnn_tpu.config import (
     ElasticConfig,
     FusedStepConfig,
     MeshConfig,
+    NetConfig,
     ObsConfig,
     PipelineConfig,
     ResilienceConfig,
@@ -517,16 +518,57 @@ def build_serve_parser(cmd: str) -> argparse.ArgumentParser:
                         "the autoscaler reads [PCNN_SERVE_WINDOW_S]")
     p.add_argument("--scenario", default=None,
                    choices=["diurnal", "flash-crowd", "slow-client",
-                            "chaos-kill", "chaos-slow"],
+                            "chaos-kill", "chaos-slow", "net-steady",
+                            "net-slow-loris", "net-kill-endpoint",
+                            "net-hot-swap-diurnal"],
                    help="drive a seeded SLO-gated traffic scenario "
                         "(serve/scenarios.py) instead of plain loadgen; "
                         "exit code reflects the p99/shed/conservation "
-                        "gates (chaos-* scenarios need --chaos)")
+                        "gates (chaos-* scenarios need --chaos; net-* "
+                        "scenarios need --listen and judge the wire tier "
+                        "too)")
     p.add_argument("--chaos", default=None, metavar="SPEC",
                    help="serving fault injection: kill-replica@SEQ kills "
                         "the replica holding dispatch batch SEQ, "
-                        "slow-replica@SEQ:MS stalls it MS ms "
+                        "slow-replica@SEQ:MS stalls it MS ms, "
+                        "kill-endpoint@SEQ kills the network endpoint at "
+                        "wire request SEQ, slow-loris@SEQ:MS stalls a "
+                        "client mid-request for MS ms "
                         "(resilience/chaos.py)")
+    nc = NetConfig.from_env()
+    g = p.add_argument_group(
+        "network front door (serve/net.py; PCNN_SERVE_* in docs/api.md)")
+    g.add_argument("--listen", action="store_true", default=nc.listen,
+                   help="serve over a real TCP socket (NDJSON protocol) "
+                        "instead of in-process submit; traffic/scenarios "
+                        "are driven through the socket transport "
+                        "[PCNN_SERVE_LISTEN]")
+    g.add_argument("--listen-host", default=nc.host,
+                   help="bind address for --listen [PCNN_SERVE_HOST]")
+    g.add_argument("--listen-port", type=int, default=nc.port,
+                   help="bind port for --listen; 0 = ephemeral (the "
+                        "supervisor respawns on whatever was bound) "
+                        "[PCNN_SERVE_PORT]")
+    g.add_argument("--conn-deadline-ms", type=float,
+                   default=nc.conn_deadline_ms,
+                   help="per-connection read/write deadline: a socket "
+                        "stalling mid-request past it is reaped as "
+                        "expired (slow-loris defense); also the budget "
+                        "of deadline-less wire requests "
+                        "[PCNN_SERVE_CONN_DEADLINE_MS]")
+    g.add_argument("--aot-cache-dir", default=nc.aot_cache_dir,
+                   help="persistent on-disk AOT-executable cache: warm "
+                        "cold-starts skip every bucket compile; torn or "
+                        "fingerprint-mismatched entries recompile with a "
+                        "typed AotCacheWarning "
+                        "[PCNN_SERVE_AOT_CACHE_DIR]")
+    g.add_argument("--supervise", action="store_true", default=nc.supervise,
+                   help="respawn a killed endpoint on the same port with "
+                        "bounded exponential backoff "
+                        "(serve/supervisor.py) [PCNN_SERVE_SUPERVISE]")
+    g.add_argument("--swap-checkpoint", default=None, metavar="PATH",
+                   help="net-hot-swap-diurnal: checkpoint to hot-swap in "
+                        "mid-peak (default: fresh seed+1 init)")
     p.add_argument("--requests", type=int,
                    default=64 if cmd == "serve" else 512,
                    help="traffic volume to drive through the stack")
@@ -566,6 +608,21 @@ def _serve_config_from_args(args: argparse.Namespace) -> ServeConfig:
     )
 
 
+def _net_config_from_args(args: argparse.Namespace) -> NetConfig:
+    env = NetConfig.from_env()
+    return NetConfig(
+        listen=args.listen or env.listen,
+        host=args.listen_host,
+        port=args.listen_port,
+        conn_deadline_ms=args.conn_deadline_ms,
+        aot_cache_dir=args.aot_cache_dir,
+        supervise=args.supervise or env.supervise,
+        respawn_attempts=env.respawn_attempts,
+        respawn_base_delay_s=env.respawn_base_delay_s,
+        respawn_max_delay_s=env.respawn_max_delay_s,
+    )
+
+
 def _run_serve(cmd: str, argv: List[str]) -> int:
     """`serve` and `loadgen` subcommands.
 
@@ -575,16 +632,17 @@ def _run_serve(cmd: str, argv: List[str]) -> int:
     traffic, and print the telemetry snapshot. `loadgen` is the
     benchmarker's view: the same stack under a chosen arrival pattern,
     reporting client-side p50/p90/p99 and shed rate (optionally as JSON).
-    No network listener on purpose: this environment has no ingress, so
-    the serving surface is in-process (batcher.submit). The transport
-    layer is a TRACKED design, not an open TODO — docs/future_work.md §6
-    pins it: an HTTP/gRPC adapter strictly in front of DynamicBatcher
-    .submit (decode → submit → await → encode; Overloaded ⇒ 429 +
-    Retry-After, DeadlineExceeded ⇒ 504), everything behind that line
-    already load-tested by serve/loadgen.py.
+    By default the surface is in-process (batcher.submit); `--listen`
+    puts the network front door (serve/net.py: NDJSON over TCP,
+    per-connection deadlines, wire-tier conservation) in front of it
+    and drives the same traffic through real sockets — optionally
+    supervised (`--supervise`: crash-fast respawn on a stable port) and
+    with the persistent AOT-executable cache (`--aot-cache-dir`)
+    warming cold starts.
     """
     args = build_serve_parser(cmd).parse_args(argv)
     cfg = _serve_config_from_args(args)
+    ncfg = _net_config_from_args(args)
 
     import jax
 
@@ -611,7 +669,8 @@ def _run_serve(cmd: str, argv: List[str]) -> int:
 
         chaos = ChaosMonkey.from_spec(args.chaos)
     t0 = time.perf_counter()
-    pool, batcher = serve_stack(handle, cfg, obs=obs_bundle, chaos=chaos)
+    pool, batcher = serve_stack(handle, cfg, obs=obs_bundle, chaos=chaos,
+                                cache_dir=ncfg.aot_cache_dir)
     startup = time.perf_counter() - t0
     if obs_bundle.enabled:
         # Exposition parity: the ServeStats counters feed the registry's
@@ -646,6 +705,13 @@ def _run_serve(cmd: str, argv: List[str]) -> int:
                           for b, s in sorted(buckets.items()))
         print(f"[serve] AOT bucket ladder compiled in {startup:.2f}s "
               f"({table})")
+    if ncfg.aot_cache_dir:
+        hits = sum(e.stats.aot_cache_hits for e in pool.engines)
+        misses = sum(e.stats.aot_cache_misses for e in pool.engines)
+        corrupt = sum(e.stats.aot_cache_corrupt for e in pool.engines)
+        print(f"[serve] AOT disk cache {ncfg.aot_cache_dir}: "
+              f"{hits} hits, {misses} misses, {corrupt} corrupt "
+              f"(warm start = zero compiles)")
 
     with batcher:
         if cmd == "serve":
@@ -669,7 +735,90 @@ def _run_serve(cmd: str, argv: List[str]) -> int:
             print(f"[serve] padded-bucket parity (n={n}→b{b}): {parity}")
 
         rc = 0
-        if args.scenario:
+        sup = None
+        endpoint = None
+        wire = None
+        if args.scenario and args.scenario.startswith("net-") \
+                and not ncfg.listen:
+            print(f"[{cmd}] scenario {args.scenario} needs --listen "
+                  f"(it judges the wire tier)")
+            return 2
+        if ncfg.listen:
+            from parallel_cnn_tpu.resilience.retry import RetryPolicy
+            from parallel_cnn_tpu.serve.net import NetServer
+            from parallel_cnn_tpu.serve.supervisor import Supervisor
+            from parallel_cnn_tpu.serve.telemetry import WireStats
+
+            wire = WireStats()
+            if obs_bundle.enabled:
+                wire.attach_registry(obs_bundle.registry)
+            # A kill-endpoint monkey arms the SERVER (first incarnation
+            # only — a respawn must not replay the death); a slow-loris
+            # monkey arms the CLIENT side of the socket transport.
+            server_chaos = (
+                chaos if chaos is not None
+                and chaos.kill_endpoint_seq is not None else None
+            )
+            client_chaos = (
+                chaos if chaos is not None
+                and chaos.slow_loris is not None else None
+            )
+            armed = [server_chaos]
+
+            def _factory(port: int, seq_start: int):
+                m = armed.pop(0) if armed else None
+                return NetServer(
+                    batcher, host=ncfg.host, port=port,
+                    conn_deadline_ms=ncfg.conn_deadline_ms, wire=wire,
+                    chaos=m, obs=obs_bundle, seq_start=seq_start,
+                ).start()
+
+            if ncfg.supervise:
+                sup = Supervisor(
+                    _factory,
+                    policy=RetryPolicy(
+                        attempts=ncfg.respawn_attempts,
+                        base_delay=ncfg.respawn_base_delay_s,
+                        max_delay=ncfg.respawn_max_delay_s,
+                        seed=args.seed,
+                    ),
+                    obs=obs_bundle, port=ncfg.port,
+                ).start()
+                endpoint = sup.server
+            else:
+                endpoint = _factory(ncfg.port, 0)
+            print(f"[{cmd}] listening on "
+                  f"{endpoint.host}:{endpoint.port} "
+                  f"(conn deadline {ncfg.conn_deadline_ms:g} ms"
+                  + (", supervised" if sup is not None else "") + ")")
+        if args.scenario and args.scenario.startswith("net-"):
+            swap_params = swap_state = None
+            if args.scenario == "net-hot-swap-diurnal":
+                from parallel_cnn_tpu.serve.engine import load_or_init
+
+                swap_params, swap_state = load_or_init(
+                    handle, args.swap_checkpoint, seed=args.seed + 1,
+                )
+            report = scenarios.run_net(
+                args.scenario, batcher, wire=wire,
+                supervisor=sup, server=endpoint, chaos=client_chaos,
+                swap_params=swap_params, swap_state=swap_state,
+                obs=obs_bundle, seed=args.seed,
+            )
+            gates = report.gates()
+            verdict = "PASS" if report.passed else "FAIL"
+            p99 = report.p99_ms
+            print(f"[{cmd}] scenario {report.name}: "
+                  f"{report.completed}/{report.requests} ok, "
+                  f"shed rate {report.shed_rate:.3f}, "
+                  f"p99 {p99:.1f} ms" if p99 is not None else
+                  f"[{cmd}] scenario {report.name}: no completions")
+            print(f"[{cmd}] gates {verdict}: " + ", ".join(
+                f"{k}={'ok' if v else 'TRIPPED'}"
+                for k, v in gates.items()
+            ))
+            rc = 0 if report.passed else 1
+        elif args.scenario:
             report = scenarios.run(
                 args.scenario, batcher,
                 seed=args.seed,
@@ -688,6 +837,27 @@ def _run_serve(cmd: str, argv: List[str]) -> int:
                 for k, v in gates.items()
             ))
             rc = 0 if report.passed else 1
+        elif ncfg.listen:
+            report = loadgen.run_closed_loop_net(
+                endpoint.address,
+                loadgen.make_samples(
+                    min(args.requests, 64), handle.in_shape,
+                    seed=args.seed,
+                ),
+                n_requests=args.requests,
+                concurrency=args.concurrency,
+                deadline_ms=args.deadline_ms or None,
+                seed=args.seed,
+                chaos=client_chaos,
+            )
+            print(f"[{cmd}] closed-net-loop: "
+                  f"{report.completed}/{report.requests} ok, "
+                  f"{report.throughput:.1f} req/s over the wire, "
+                  f"shed rate {report.shed_rate:.3f}")
+            lat = report.latency.summary(scale=1e3)
+            if lat.get("count"):
+                print(f"[{cmd}] latency p50 {lat['p50']:.2f} ms, "
+                      f"p90 {lat['p90']:.2f} ms, p99 {lat['p99']:.2f} ms")
         else:
             report = loadgen.run(
                 batcher,
@@ -706,6 +876,17 @@ def _run_serve(cmd: str, argv: List[str]) -> int:
             if lat.get("count"):
                 print(f"[{cmd}] latency p50 {lat['p50']:.2f} ms, "
                       f"p90 {lat['p90']:.2f} ms, p99 {lat['p99']:.2f} ms")
+        if ncfg.listen:
+            (sup if sup is not None else endpoint).close()
+            w = wire.snapshot()
+            print(f"[{cmd}] wire: {w['submitted']} submitted = "
+                  f"{w['completed']} completed + {w['shed']} shed + "
+                  f"{w['expired']} expired + {w['failed']} failed "
+                  f"({'balanced' if wire.balanced() else 'IMBALANCED'}; "
+                  f"{w['reaped']} reaped, "
+                  f"{w['endpoint_deaths']} endpoint deaths"
+                  + (f", {sup.respawns} respawns" if sup is not None
+                     else "") + ")")
         if scaler is not None:
             scaler.close()
             snap = scaler.snapshot()
@@ -722,6 +903,8 @@ def _run_serve(cmd: str, argv: List[str]) -> int:
                 out["admission"] = batcher.admission.snapshot()
             if scaler is not None:
                 out["autoscaler"] = scaler.snapshot()
+            if wire is not None:
+                out["wire"] = wire.snapshot()
             with open(args.json, "w") as f:
                 json_mod.dump(out, f, indent=2)
             print(f"[{cmd}] report written to {args.json}")

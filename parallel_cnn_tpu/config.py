@@ -430,6 +430,78 @@ class ServeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class NetConfig:
+    """Network front-door policy (serve/net.py + serve/supervisor.py —
+    the out-of-process serving tier in front of the DynamicBatcher;
+    docs/serving.md §network tier has the deadline mapping and the
+    supervisor state machine)."""
+
+    # Serve over a real TCP listener (serve/net.py) instead of the
+    # historical in-process-only surface.
+    listen: bool = False
+    # Bind address for the listener. Loopback by default — the front
+    # door is an experiment harness, not a hardened public ingress.
+    host: str = "127.0.0.1"
+    # TCP port; 0 binds an ephemeral port (the bound port is reported
+    # on NetServer.port and kept stable across supervisor respawns).
+    port: int = 0
+    # Per-connection read/write deadline (ms): a socket that stalls
+    # mid-request past this budget is reaped as `expired` (the
+    # slow-loris defense), and a blocked response write is abandoned
+    # the same way. Also the submit() budget inherited by requests
+    # that do not carry their own deadline_ms.
+    conn_deadline_ms: float = 2000.0
+    # Persistent on-disk AOT-executable cache directory (engine.py):
+    # a cold-started / respawned / autoscaler-grown replica loads its
+    # per-bucket executables instead of recompiling. None = off.
+    aot_cache_dir: Optional[str] = None
+    # Supervise the endpoint: respawn a killed listener with bounded
+    # exponential backoff (resilience/retry.py) and reconcile the
+    # journal across the restart.
+    supervise: bool = False
+    # Supervisor respawn backoff envelope (RetryPolicy fields).
+    respawn_attempts: int = 4
+    respawn_base_delay_s: float = 0.05
+    respawn_max_delay_s: float = 1.0
+
+    def __post_init__(self):
+        if not 0 <= self.port <= 65535:
+            raise ValueError(f"port must be in [0, 65535], got {self.port}")
+        if self.conn_deadline_ms <= 0:
+            raise ValueError(
+                f"conn_deadline_ms must be > 0, got {self.conn_deadline_ms}"
+            )
+        if self.respawn_attempts < 1:
+            raise ValueError(
+                f"respawn_attempts must be >= 1, got {self.respawn_attempts}"
+            )
+        if self.respawn_base_delay_s < 0 or self.respawn_max_delay_s < 0:
+            raise ValueError("respawn delays must be >= 0")
+
+    @staticmethod
+    def from_env() -> "NetConfig":
+        """NetConfig with PCNN_SERVE_* environment overrides applied over
+        the defaults (docs/api.md has the table). Same no-sentinel idiom
+        as ServeConfig.from_env: env re-defaults, CLI flags override."""
+        e = os.environ.get
+        return NetConfig(
+            listen=e("PCNN_SERVE_LISTEN", "0") != "0",
+            host=e("PCNN_SERVE_HOST", "127.0.0.1"),
+            port=int(e("PCNN_SERVE_PORT", "0")),
+            conn_deadline_ms=float(e("PCNN_SERVE_CONN_DEADLINE_MS", "2000")),
+            aot_cache_dir=e("PCNN_SERVE_AOT_CACHE_DIR") or None,
+            supervise=e("PCNN_SERVE_SUPERVISE", "0") != "0",
+            respawn_attempts=int(e("PCNN_SERVE_RESPAWN_ATTEMPTS", "4")),
+            respawn_base_delay_s=float(
+                e("PCNN_SERVE_RESPAWN_BASE_DELAY_S", "0.05")
+            ),
+            respawn_max_delay_s=float(
+                e("PCNN_SERVE_RESPAWN_MAX_DELAY_S", "1.0")
+            ),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class ElasticConfig:
     """Elastic-training policy (resilience/elastic.py — in-flight re-mesh
     + ZeRO-3 reshard on preemption, chaos-injected device loss, or device
@@ -777,6 +849,9 @@ class Config:
     # into 1F1B microbatch pipelining over a (stage, data) mesh
     # (parallel/pipeline.py + train/pipeline_schedule.py).
     pipeline: Optional[PipelineConfig] = None
+    # None = in-process serving only; a NetConfig opts the serve stack
+    # into the supervised TCP front door (serve/net.py + supervisor.py).
+    net: Optional[NetConfig] = None
     model: str = "lenet_ref"
 
     def replace(self, **kw) -> "Config":

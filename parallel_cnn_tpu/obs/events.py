@@ -32,6 +32,21 @@ age and whether the hard barrier fired), ``easgd_round`` (one elastic-
 averaging ρ-pull, bracketed by the ``train.easgd_round`` span), and
 ``sentinel_drop`` (a poisoned worker gradient rejected before it could
 reach the server/center params).
+The network front door (serve/net.py + serve/supervisor.py) adds a
+wire-tier request lifecycle ``net_submit`` / ``net_complete`` /
+``net_shed`` / ``net_expired`` / ``net_failed`` — obeying the same
+conservation law under the ``net_`` prefix (``conservation(counts,
+prefix="net_")``) — plus ``conn_open`` (connection accepted),
+``conn_expired`` (a stalled/slow-loris connection reaped at the read
+deadline, its partial request counted ``net_expired``),
+``endpoint_killed`` (endpoint death; in-flight wire requests journaled
+``net_failed``, never silently lost), ``endpoint_respawned``
+(supervisor restart, with downtime), ``hot_swap_begin`` /
+``hot_swap_done`` (zero-downtime weight swap bracket), and the
+engine's persistent executable cache ``aot_cache_hit`` /
+``aot_cache_miss`` / ``aot_cache_corrupt`` (torn, damaged, or
+fingerprint-mismatched entries degrade to recompile with a typed
+warning).
 """
 
 from __future__ import annotations
@@ -130,22 +145,26 @@ def merge_journals(paths: Sequence[str]) -> List[Dict[str, Any]]:
     return records
 
 
-def conservation(counts: Dict[str, int]) -> Optional[str]:
+def conservation(counts: Dict[str, int], prefix: str = "") -> Optional[str]:
     """Check the serve lifecycle conservation law over per-kind counts.
 
     Returns None when conserved (or when no submits were journaled),
-    else a human-readable description of the imbalance.
+    else a human-readable description of the imbalance. ``prefix``
+    selects which tier's lifecycle to check: ``""`` for the batcher
+    tier (``submit``/``complete``/...), ``"net_"`` for the wire tier
+    journaled by serve/net.py (``net_submit``/``net_complete``/...).
     """
-    submitted = counts.get("submit", 0)
+    submitted = counts.get(prefix + "submit", 0)
     if submitted == 0:
         return None
     accounted = (
-        counts.get("complete", 0) + counts.get("shed", 0)
-        + counts.get("expired", 0) + counts.get("failed", 0)
+        counts.get(prefix + "complete", 0) + counts.get(prefix + "shed", 0)
+        + counts.get(prefix + "expired", 0)
+        + counts.get(prefix + "failed", 0)
     )
     if accounted != submitted:
         return (
-            f"journal conservation violated: submit={submitted} != "
-            f"complete+shed+expired+failed={accounted}"
+            f"journal conservation violated: {prefix}submit={submitted} != "
+            f"{prefix}complete+shed+expired+failed={accounted}"
         )
     return None

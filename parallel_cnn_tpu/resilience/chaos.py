@@ -45,11 +45,23 @@ tests/test_resilience.py drives training through it end-to-end. Faults:
   sync ring visibly stalls while the bounded-staleness/EASGD modes
   (train/async_dp.py) visibly don't. One-shot, journaled
   ``chaos_slow_worker``.
+- **Endpoint death at wire request N** (``kill_endpoint_seq=N``, spec
+  ``kill-endpoint@N``): the serving network endpoint (serve/net.py)
+  dies the moment it has accepted wire request N — in-flight wire
+  requests are journaled ``failed`` (never silently lost) and the
+  supervisor's bounded-backoff respawn path (serve/supervisor.py) is
+  what keeps conservation across the restart. One-shot.
+- **Slow-loris client at wire request N** (``slow_loris=(N, MS)``, spec
+  ``slow-loris@N:MS``): the loadgen socket client sending wire request
+  N stalls MS milliseconds mid-body — past the server's per-connection
+  read deadline the half-read request must be reaped as ``expired``,
+  not hang a handler thread. One-shot, client-side injection.
 
 The full CLI spec grammar (``_GRAMMAR`` below, consumed by
 ``from_spec``): ``nan@STEP`` | ``kill@EPOCH`` | ``kill9@EPOCH`` |
 ``resize@STEP:±K`` | ``kill-replica@SEQ`` | ``slow-replica@SEQ:MS`` |
-``slow-worker@STEP:MS`` | ``slow-stage@STEP:MS``.
+``slow-worker@STEP:MS`` | ``slow-stage@STEP:MS`` |
+``kill-endpoint@SEQ`` | ``slow-loris@SEQ:MS``.
 
 No wall clocks, no unseeded randomness — a chaos run replays exactly.
 """
@@ -77,6 +89,8 @@ SPEC_KINDS: Tuple[str, ...] = (
     "slow-replica@SEQ:MS",
     "slow-worker@STEP:MS",
     "slow-stage@STEP:MS",
+    "kill-endpoint@SEQ",
+    "slow-loris@SEQ:MS",
 )
 
 _GRAMMAR = "expected " + ", ".join(SPEC_KINDS[:-1]) + f" or {SPEC_KINDS[-1]}"
@@ -114,6 +128,8 @@ class ChaosMonkey:
         slow_replica: Optional[Tuple[int, float]] = None,
         slow_worker: Optional[Tuple[int, float]] = None,
         slow_stage: Optional[Tuple[int, float]] = None,
+        kill_endpoint_seq: Optional[int] = None,
+        slow_loris: Optional[Tuple[int, float]] = None,
     ):
         self.nan_step = nan_step
         self.kill_epoch = kill_epoch
@@ -135,6 +151,13 @@ class ChaosMonkey:
         # `step` stalls `ms` milliseconds at a stage boundary
         # (train/zoo.py polls slow_stage_at before the step dispatch).
         self.slow_stage = slow_stage
+        # Wire-request sequence number at which the serving network
+        # endpoint dies (serve/net.py polls kill_endpoint_at).
+        self.kill_endpoint_seq = kill_endpoint_seq
+        # (seq, ms): the loadgen socket client sending wire request
+        # `seq` stalls `ms` milliseconds mid-body (serve/loadgen.py's
+        # socket transport polls slow_loris_at before each send).
+        self.slow_loris = slow_loris
         self.steps_seen = 0
         self.nan_fired = False
         self.kill_fired = False
@@ -143,6 +166,8 @@ class ChaosMonkey:
         self.slow_replica_fired = False
         self.slow_worker_fired = False
         self.slow_stage_fired = False
+        self.kill_endpoint_fired = False
+        self.slow_loris_fired = False
 
     def after_step(self, tree: Any, loss: Any) -> Tuple[Any, Any]:
         """Post-step hook: returns (possibly poisoned) (tree, loss)."""
@@ -230,6 +255,31 @@ class ChaosMonkey:
             return self.slow_stage[1]
         return None
 
+    def kill_endpoint_at(self, seq: int) -> bool:
+        """Wire hook (serve net endpoint): True exactly once, for the
+        endpoint that has just accepted wire request ``seq``."""
+        if (
+            self.kill_endpoint_seq is not None
+            and not self.kill_endpoint_fired
+            and seq >= self.kill_endpoint_seq
+        ):
+            self.kill_endpoint_fired = True
+            return True
+        return False
+
+    def slow_loris_at(self, seq: int) -> Optional[float]:
+        """Client hook (loadgen socket transport): the mid-body stall in
+        milliseconds, exactly once, for the client sending wire request
+        ``seq``; None otherwise."""
+        if (
+            self.slow_loris is not None
+            and not self.slow_loris_fired
+            and seq >= self.slow_loris[0]
+        ):
+            self.slow_loris_fired = True
+            return self.slow_loris[1]
+        return None
+
     @classmethod
     def from_spec(cls, spec: str) -> "ChaosMonkey":
         """Parse a CLI fault spec (full grammar in ``SPEC_KINDS``):
@@ -238,13 +288,17 @@ class ChaosMonkey:
         ``kill-replica@SEQ`` (serve replica death at dispatched batch
         SEQ), ``slow-replica@SEQ:MS`` (serve replica stalls MS ms at
         dispatched batch SEQ), ``slow-worker@STEP:MS`` (training
-        worker stalls MS ms dispatching gradient step STEP), or
+        worker stalls MS ms dispatching gradient step STEP),
         ``slow-stage@STEP:MS`` (pipelined trainer stalls MS ms at a
-        stage boundary dispatching optimizer step STEP)."""
+        stage boundary dispatching optimizer step STEP),
+        ``kill-endpoint@SEQ`` (serving network endpoint dies at wire
+        request SEQ), or ``slow-loris@SEQ:MS`` (loadgen socket client
+        stalls MS ms mid-body sending wire request SEQ)."""
         kind, sep, arg = spec.partition("@")
         if not sep or not arg:
             raise ValueError(f"bad chaos spec {spec!r}; {_GRAMMAR}")
-        if kind in ("slow-replica", "slow-worker", "slow-stage"):
+        if kind in ("slow-replica", "slow-worker", "slow-stage",
+                    "slow-loris"):
             seq, ssep, ms = arg.partition(":")
             try:
                 if not ssep:
@@ -256,6 +310,8 @@ class ChaosMonkey:
                     return cls(slow_worker=(int(seq), delay))
                 if kind == "slow-stage":
                     return cls(slow_stage=(int(seq), delay))
+                if kind == "slow-loris":
+                    return cls(slow_loris=(int(seq), delay))
                 return cls(slow_replica=(int(seq), delay))
             except ValueError:
                 raise ValueError(
@@ -288,6 +344,8 @@ class ChaosMonkey:
             return cls(kill_epoch=n, kill_signal=signal.SIGKILL)
         if kind == "kill-replica":
             return cls(kill_replica_seq=n)
+        if kind == "kill-endpoint":
+            return cls(kill_endpoint_seq=n)
         raise ValueError(f"unknown chaos fault {kind!r} in {spec!r}")
 
 

@@ -16,7 +16,14 @@ north star; docs/serving.md for the design).
                ReplicaPool from windowed telemetry (AutoScaler)
     scenarios  seeded traffic scenarios with explicit p99/shed gates
                (diurnal, flash-crowd, slow-client, chaos-kill/slow)
+               plus the net suites judged at the wire tier
     loadgen    seeded closed-/open-loop traffic + client retry protocol
+               (in-process and over the socket transport)
+    net        stdlib TCP front door: NDJSON protocol, per-connection
+               read/write deadlines, slow-loris reaping, wire-tier
+               conservation (NetServer / WireStats)
+    supervisor crash-fast respawn with bounded backoff on a stable
+               port, and the zero-downtime weight hot_swap roll
 """
 
 from parallel_cnn_tpu.serve.admission import AdmissionController  # noqa: F401
@@ -29,15 +36,20 @@ from parallel_cnn_tpu.serve.batcher import (  # noqa: F401
     serve_stack,
 )
 from parallel_cnn_tpu.serve.engine import (  # noqa: F401
+    AotCacheWarning,
     Engine,
     EngineStats,
     ReplicaPool,
     bucket_for,
     load_or_init,
 )
+from parallel_cnn_tpu.serve.net import NetServer  # noqa: F401
 from parallel_cnn_tpu.serve.registry import ModelHandle, available, get  # noqa: F401
 from parallel_cnn_tpu.serve.scenarios import (  # noqa: F401
+    NET_SCENARIOS,
     SCENARIOS,
+    NetScenarioReport,
     ScenarioReport,
 )
-from parallel_cnn_tpu.serve.telemetry import ServeStats  # noqa: F401
+from parallel_cnn_tpu.serve.supervisor import Supervisor, hot_swap  # noqa: F401
+from parallel_cnn_tpu.serve.telemetry import ServeStats, WireStats  # noqa: F401

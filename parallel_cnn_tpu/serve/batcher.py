@@ -565,13 +565,15 @@ def serve_stack(
     obs: Optional["obs_lib.Obs"] = None,
     chaos=None,
     admission=None,
+    cache_dir=None,
 ):
     """(pool, batcher) wired from a config.ServeConfig — the one-call
     constructor the CLI, benches, and dryrun share. ``chaos`` (a
     resilience.chaos.ChaosMonkey) arms kill-replica / slow-replica fault
     injection. ``admission`` overrides the controller instance; by
     default one is built when ``cfg.admission`` is set (the SLO surface
-    — serve/admission.py)."""
+    — serve/admission.py). ``cache_dir`` enables the engines'
+    persistent AOT-executable cache (config.NetConfig.aot_cache_dir)."""
     from parallel_cnn_tpu.serve.engine import ReplicaPool
 
     pool = ReplicaPool(
@@ -582,6 +584,7 @@ def serve_stack(
         devices=devices,
         precompile=cfg.precompile,
         obs=obs,
+        cache_dir=cache_dir,
     )
     if admission is None and getattr(cfg, "admission", False):
         from parallel_cnn_tpu.serve.admission import AdmissionController

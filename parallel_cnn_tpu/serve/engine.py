@@ -27,8 +27,13 @@ Design (tentpole of the serve/ subsystem):
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import json
+import os
+import pickle
 import threading
 import time
+import warnings
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -38,12 +43,41 @@ from parallel_cnn_tpu import obs as obs_lib
 
 @dataclasses.dataclass
 class EngineStats:
-    """AOT compile-cache counters (tests pin the hit/miss accounting)."""
+    """AOT compile-cache counters (tests pin the hit/miss accounting).
+
+    ``aot_hits`` counts in-memory executable reuse on the predict path;
+    the ``aot_cache_*`` trio counts the persistent on-disk tier
+    (hit = executable deserialized instead of compiled, miss = no entry
+    on disk, corrupt = an entry existed but was torn / bit-rotted /
+    fingerprint-mismatched and fell back to recompile). All mutations
+    happen under the owning Engine's lock."""
 
     aot_compiles: int = 0
     aot_hits: int = 0
     predicts: int = 0
     compile_seconds: Dict[int, float] = dataclasses.field(default_factory=dict)
+    aot_cache_hits: int = 0
+    aot_cache_misses: int = 0
+    aot_cache_corrupt: int = 0
+
+
+class AotCacheWarning(UserWarning):
+    """A persistent AOT-cache entry could not be used — torn write, byte
+    corruption, or a jax/XLA/weights fingerprint mismatch. The engine
+    recompiles and overwrites the entry; this warning is the typed
+    signal of the degraded path (same contract as checkpoint.restore's
+    typed ValueError: loud, specific, never a crash)."""
+
+
+class AotCacheError(RuntimeError):
+    """Internal: one on-disk AOT cache entry is unusable (the message
+    says why). Callers catch this, warn :class:`AotCacheWarning`, and
+    recompile — it never escapes the engine."""
+
+
+#: On-disk entry magic; bump the suffix when the layout changes so an
+#: old-layout entry reads as a typed mismatch, not a pickle crash.
+_AOT_MAGIC = b"PCNN-AOT1\n"
 
 
 class ReplicaDead(RuntimeError):
@@ -97,6 +131,26 @@ def load_or_init(handle, checkpoint: Optional[str] = None, seed: int = 0):
         return loaded.params, loaded.model_state
 
 
+def params_digest(params: Any, model_state: Any) -> str:
+    """Content hash of the weights an executable was compiled against.
+
+    The engine closes predict over the params/model_state arrays, so the
+    compiled executable *is* a function of their values — a persistent
+    cache entry is only valid for the exact weights it was built from
+    (the hot-swap path depends on this: new checkpoint → new digest →
+    stale entries read as fingerprint mismatches, never as silently
+    wrong answers)."""
+    import jax
+
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves((params, model_state)):
+        a = np.asarray(leaf)
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
 def bucket_for(n: int, max_batch: int) -> int:
     """Smallest power-of-two bucket holding n requests."""
     if n < 1:
@@ -129,6 +183,7 @@ class Engine:
         seed: int = 0,
         precompile: bool = False,
         obs: Optional["obs_lib.Obs"] = None,
+        cache_dir: Optional[str] = None,
     ):
         import jax
 
@@ -151,6 +206,28 @@ class Engine:
         self.stats = EngineStats()
         self._exec: Dict[int, Any] = {}
         self._lock = threading.Lock()
+        # Persistent on-disk AOT-executable tier: a respawned / grown /
+        # cold-started replica deserializes its per-bucket executables
+        # instead of recompiling. The fingerprint pins everything the
+        # executable is a function of — an entry that does not match
+        # EXACTLY falls back to recompile with a typed warning.
+        self.cache_dir = cache_dir
+        self._cache_ok = cache_dir is not None
+        if self._cache_ok:
+            os.makedirs(cache_dir, exist_ok=True)
+            self._fingerprint = {
+                "jax": jax.__version__,
+                "backend": getattr(
+                    getattr(self.device, "client", None),
+                    "platform_version", "?",
+                ),
+                "platform": self.device.platform,
+                "device_kind": getattr(self.device, "device_kind", "?"),
+                "device": int(self.device.id),
+                "model": handle.name,
+                "in_shape": list(handle.in_shape),
+                "params": params_digest(self._params, self._state),
+            }
         if precompile:
             self.precompile()
 
@@ -186,6 +263,141 @@ class Engine:
             self.obs.event("aot_compile", bucket=bucket, seconds=dt)
         return compiled
 
+    # -- persistent on-disk executable tier -----------------------------
+
+    def _cache_path(self, bucket: int) -> str:
+        """One entry per (model, bucket, device slot). The full
+        fingerprint lives in the entry header, not the name — so a jax
+        upgrade, weight change (hot-swap), or platform move reads as a
+        *typed mismatch* that recompiles and overwrites in place,
+        instead of silently orphaning stale files."""
+        return os.path.join(
+            self.cache_dir,
+            f"{self.handle.name}-b{bucket}-d{self.device.id}.aotx",
+        )
+
+    def _cache_read(self, bucket: int):
+        """Deserialize one entry; None on a clean miss (no file), raises
+        AotCacheError on a torn / corrupt / mismatched entry."""
+        path = self._cache_path(bucket)
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except FileNotFoundError:
+            return None
+        except OSError as e:
+            raise AotCacheError(f"unreadable cache entry {path}: {e}")
+        if len(blob) < len(_AOT_MAGIC) + 8 or not blob.startswith(_AOT_MAGIC):
+            raise AotCacheError(f"bad magic / torn header in {path}")
+        off = len(_AOT_MAGIC)
+        hlen = int.from_bytes(blob[off:off + 8], "big")
+        off += 8
+        if len(blob) < off + hlen:
+            raise AotCacheError(f"torn header in {path}")
+        try:
+            header = json.loads(blob[off:off + hlen])
+        except ValueError as e:
+            raise AotCacheError(f"corrupt header in {path}: {e}")
+        fp = dict(self._fingerprint, bucket=bucket)
+        if header.get("fingerprint") != fp:
+            raise AotCacheError(
+                f"fingerprint mismatch in {path} (stale jax/XLA toolchain, "
+                f"different device, or different weights)"
+            )
+        payload = blob[off + hlen:]
+        if len(payload) != header.get("nbytes"):
+            raise AotCacheError(
+                f"torn payload in {path}: {len(payload)} != "
+                f"{header.get('nbytes')} bytes"
+            )
+        if hashlib.sha256(payload).hexdigest() != header.get("sha256"):
+            raise AotCacheError(f"payload checksum mismatch in {path}")
+        from jax.experimental import serialize_executable as se
+
+        try:
+            raw, in_tree, out_tree = pickle.loads(payload)
+            return se.deserialize_and_load(raw, in_tree, out_tree)
+        except Exception as e:  # noqa: BLE001 — any load failure degrades
+            raise AotCacheError(f"undeserializable entry {path}: {e}")
+
+    def _cache_load(self, bucket: int):
+        """The accounting wrapper around ``_cache_read``: returns the
+        executable or None, counting hit / miss / corrupt and emitting
+        the matching journal event. Corruption warns AotCacheWarning —
+        the caller recompiles."""
+        try:
+            ex = self._cache_read(bucket)
+        except AotCacheError as e:
+            warnings.warn(
+                f"AOT cache entry unusable, recompiling bucket {bucket}: "
+                f"{e}",
+                AotCacheWarning,
+                stacklevel=3,
+            )
+            with self._lock:
+                self.stats.aot_cache_corrupt += 1
+            if self.obs.enabled:
+                self.obs.event("aot_cache_corrupt", bucket=bucket,
+                               reason=str(e))
+            return None
+        with self._lock:
+            if ex is not None:
+                self.stats.aot_cache_hits += 1
+            else:
+                self.stats.aot_cache_misses += 1
+        if self.obs.enabled:
+            self.obs.event(
+                "aot_cache_hit" if ex is not None else "aot_cache_miss",
+                bucket=bucket,
+            )
+        return ex
+
+    def _cache_store(self, bucket: int, compiled) -> None:
+        """Serialize one executable atomically (tmp + rename, same torn-
+        write discipline as checkpoint.save). A backend that cannot
+        serialize disables the cache for this engine with one warning."""
+        from jax.experimental import serialize_executable as se
+
+        try:
+            payload = pickle.dumps(se.serialize(compiled))
+        except Exception as e:  # noqa: BLE001 — backend-dependent support
+            with self._lock:
+                self._cache_ok = False
+            warnings.warn(
+                f"AOT executable serialization unsupported on this "
+                f"backend; persistent cache disabled: {e}",
+                AotCacheWarning,
+                stacklevel=3,
+            )
+            return
+        header = json.dumps({
+            "fingerprint": dict(self._fingerprint, bucket=bucket),
+            "nbytes": len(payload),
+            "sha256": hashlib.sha256(payload).hexdigest(),
+        }).encode()
+        path = self._cache_path(bucket)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(_AOT_MAGIC)
+            f.write(len(header).to_bytes(8, "big"))
+            f.write(header)
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def _obtain(self, bucket: int):
+        """Load-or-compile one bucket (not yet in the memory map).
+        Returns (executable, from_disk_cache)."""
+        if self._cache_ok:
+            ex = self._cache_load(bucket)
+            if ex is not None:
+                return ex, True
+        ex = self._compile(bucket)
+        if self._cache_ok:
+            self._cache_store(bucket, ex)
+        return ex, False
+
     def _executable(self, bucket: int):
         with self._lock:
             ex = self._exec.get(bucket)
@@ -195,11 +407,12 @@ class Engine:
         # Compile outside the lock (minutes on big models — don't block
         # other buckets), then publish; a racing double-compile is
         # harmless and keeps the first one.
-        ex = self._compile(bucket)
+        ex, from_disk = self._obtain(bucket)
         with self._lock:
             if bucket not in self._exec:
                 self._exec[bucket] = ex
-                self.stats.aot_compiles += 1
+                if not from_disk:
+                    self.stats.aot_compiles += 1
             else:
                 ex = self._exec[bucket]
             return ex
@@ -207,16 +420,21 @@ class Engine:
     def precompile(self) -> Dict[int, float]:
         """Compile every bucket now; returns {bucket: compile seconds}.
         Idempotent — already-cached buckets are skipped (not counted as
-        hits: only predict-path lookups feed the hit counter)."""
+        hits: only predict-path lookups feed the hit counter). With a
+        persistent cache attached, buckets deserialized from disk count
+        as cache hits, not compiles — a warm cold start compiles
+        nothing (the restart-to-first-response win the supervisor's
+        crash-fast restart depends on)."""
         for b in self.buckets:
             with self._lock:
                 if b in self._exec:
                     continue
-            ex = self._compile(b)
+            ex, from_disk = self._obtain(b)
             with self._lock:
                 if b not in self._exec:
                     self._exec[b] = ex
-                    self.stats.aot_compiles += 1
+                    if not from_disk:
+                        self.stats.aot_compiles += 1
         return dict(self.stats.compile_seconds)
 
     def predict(self, x) -> np.ndarray:
@@ -283,6 +501,7 @@ class ReplicaPool:
         seed: int = 0,
         precompile: bool = False,
         obs: Optional["obs_lib.Obs"] = None,
+        cache_dir: Optional[str] = None,
     ):
         import jax
 
@@ -297,6 +516,7 @@ class ReplicaPool:
         self.devices = devices
         self._precompile = precompile
         self.obs = obs
+        self.cache_dir = cache_dir
         self.engines = [
             Engine(
                 handle,
@@ -306,6 +526,7 @@ class ReplicaPool:
                 device=devices[i % len(devices)],
                 precompile=precompile,
                 obs=obs,
+                cache_dir=cache_dir,
             )
             for i in range(n_replicas)
         ]
@@ -360,15 +581,18 @@ class ReplicaPool:
         replacement device here). The fresh Engine has an empty AOT
         cache: buckets recompile lazily on first use (or eagerly when the
         pool was built with ``precompile=True``)."""
+        with self._lock:
+            params, model_state = self._params, self._model_state
         eng = Engine(
             self.handle,
-            params=self._params,
-            model_state=self._model_state,
+            params=params,
+            model_state=model_state,
             max_batch=self.max_batch,
             device=device if device is not None
             else self.devices[i % len(self.devices)],
             precompile=self._precompile,
             obs=self.obs,
+            cache_dir=self.cache_dir,
         )
         with self._lock:
             self.engines[i] = eng
@@ -389,21 +613,36 @@ class ReplicaPool:
             free = [i for i, a in enumerate(self._alive) if not a]
         if free:
             return self.respawn(free[0], device=device)
+        with self._lock:
+            params, model_state = self._params, self._model_state
         eng = Engine(
             self.handle,
-            params=self._params,
-            model_state=self._model_state,
+            params=params,
+            model_state=model_state,
             max_batch=self.max_batch,
             device=device if device is not None
             else self.devices[len(self.engines) % len(self.devices)],
             precompile=self._precompile,
             obs=self.obs,
+            cache_dir=self.cache_dir,
         )
         with self._lock:
             self.engines.append(eng)
             self._alive.append(True)
             self._draining.append(False)
             return len(self.engines) - 1
+
+    def set_weights(self, params: Any, model_state: Any = None) -> None:
+        """Swap the pool's host-side weight copies: every replica built
+        FROM NOW ON (grow / respawn) serves the new weights; existing
+        replicas keep serving the old ones until retired. This is the
+        hot-swap primitive (serve/supervisor.py drives the rolling
+        grow-new → drain-old → retire sequence around it) — deliberately
+        NOT an in-place mutation of live engines, whose executables
+        close over the old arrays."""
+        with self._lock:
+            self._params = params
+            self._model_state = model_state if model_state is not None else {}
 
     def drain(self, i: int) -> None:
         """Make replica ``i`` unroutable while leaving it alive: no new
@@ -412,6 +651,14 @@ class ReplicaPool:
         it once the caller has seen the in-flight count hit zero."""
         with self._lock:
             self._draining[i] = True
+
+    def undrain(self, i: int) -> None:
+        """Abort a drain: return a still-alive replica to rotation (the
+        hot-swap stuck-drain escape hatch — a swap that can't empty a
+        replica's in-flight queue must put it back, not kill it)."""
+        with self._lock:
+            if self._alive[i]:
+                self._draining[i] = False
 
     def retire(self, i: int) -> None:
         """Free a drained slot: the replica is gone (predict raises

@@ -125,3 +125,11 @@ def pytest_configure(config):
         "bound, cost-model splitter, stages=1 bit-exactness, multi-stage "
         "loss parity, ZeRO-2/bf16 composition, slow-stage chaos grammar)",
     )
+    config.addinivalue_line(
+        "markers",
+        "serve_net: network front-door tests (serve/net.py, "
+        "serve/supervisor.py — wire conservation over real sockets, "
+        "slow-loris reaping, kill-endpoint respawn, persistent AOT "
+        "cache round-trip + corruption fallback, hot-swap zero-failed, "
+        "NetConfig layering)",
+    )
