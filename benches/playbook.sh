@@ -53,6 +53,14 @@
 #             unsupervised trip / hot-swap zero-failed). CPU-only and
 #             self-contained — gates commits like comm-multihost;
 #             SERVE_NET_GATE is the contract line.
+#   tune      autotuner gate (benches/run.py --suite autotune): the cost
+#             model's predicted plan ranking vs measured throughput on
+#             the 8-virtual-device CPU mesh (pairwise order gate, with
+#             the doctored-inversion anti-vacuity check) plus the
+#             predictive-autoscaler flash-crowd leg (first scale-up
+#             carries reason=predictive and lands before any shed).
+#             CPU-only and self-contained — gates commits like
+#             comm-multihost; AUTOTUNE_GATE is the contract line.
 #   serve-chaos
 #             SLO-guarded serving gate (benches/run.py --suite serve):
 #             seeded scenario suites (diurnal / flash-crowd /
@@ -179,6 +187,24 @@ if [ "$MODE" = "net" ]; then
   # wire ledgers, the loris reaped, the supervised kill ridden through,
   # the unsupervised trip proven, the hot swap zero-failed.
   grep -q 'SERVE_NET_GATE PASS' "$OUT" || RC=1
+  [ $RC -ne 0 ] && OVERALL=1
+  echo "=== playbook ${MODE} end rc=${OVERALL} $(date -u +%FT%TZ) ===" >> "$LOG"
+  exit $OVERALL
+fi
+
+if [ "$MODE" = "tune" ]; then
+  echo "--- autotune ranking + predictive-scaler gate ---" >> "$LOG"
+  OUT="docs/autotune_${TAG}.txt"
+  # 8 virtual devices: the measured candidates span flat data rings and
+  # (stage, data) pipeline meshes over the full emulated device set.
+  timeout 900 env JAX_PLATFORMS=cpu PCNN_JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python benches/run.py --quick --suite autotune > "$OUT" 2>&1
+  RC=$?; echo "tune rc=$RC" >> "$LOG"
+  # The gate line is the contract: measured ranking agrees with the
+  # model, the doctored table trips, the predictive scale-up lands
+  # before any shed.
+  grep -q 'AUTOTUNE_GATE PASS' "$OUT" || RC=1
   [ $RC -ne 0 ] && OVERALL=1
   echo "=== playbook ${MODE} end rc=${OVERALL} $(date -u +%FT%TZ) ===" >> "$LOG"
   exit $OVERALL

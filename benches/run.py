@@ -2181,6 +2181,248 @@ def bench_pipeline(quick: bool) -> List[Row]:
     return rows
 
 
+def bench_autotune(quick: bool) -> List[Row]:
+    """--suite autotune: the cost-model autotuner behind AUTOTUNE_GATE.
+
+    Leg 1 — ranking validation: four candidate plans that differ ONLY
+    in the dimensions an 8-virtual-device CPU host can actually measure
+    (accumulation factor → scan/collective pass count, pipeline stages →
+    1F1B bubble) are scored by the analytic model under the ``cpu-emu``
+    hardware profile and then timed for real on identical data.  The
+    gate is analysis.autotune.order_gate: the measured throughput
+    ordering must agree with the model on >= 75% of the pairs the model
+    separates by >= 1.10x (near-ties don't vote — CPU noise can't
+    adjudicate them).  The comm-impl/wire-dtype dimensions are NOT
+    measured here — virtual devices share one memory bus, so wire bytes
+    don't cost wall-clock; those closed forms are validated exactly, by
+    byte accounting, in the graftcheck cost family (docs/autotuning.md
+    "Ranking validation" has the split).  Anti-vacuity: a doctored
+    table that inverts the model's predictions must FAIL the same gate.
+
+    Leg 2 — predictive autoscaler: a flash crowd against a 1→2-replica
+    lenet_ref stack with admission ON (the EWMAs the capacity planner
+    reads) and a slow-replica stall arming a real capacity deficit.
+    The serve SLO is set far above CPU latency so the REACTIVE
+    classifier never trips — any scale-up must come from the predictive
+    branch (serve/capacity.py).  Gates: >= 1 scale-up whose journal
+    event carries reason="predictive", ZERO sheds journaled before the
+    first scale-up (journal seq order), zero unrecovered shed rate, and
+    server-side conservation.  PR 11's reactive SERVE_SLO_GATE legs run
+    unchanged in --suite serve.
+
+    Any violated expectation appends an error row (rc 1) and flips the
+    contract line to AUTOTUNE_GATE FAIL — playbook.sh's tune mode greps
+    for it."""
+    import tempfile
+
+    from parallel_cnn_tpu import obs as obs_lib
+    from parallel_cnn_tpu.analysis import autotune as at
+    from parallel_cnn_tpu.analysis import hw_profiles
+    from parallel_cnn_tpu.config import (CommConfig, MeshConfig, ObsConfig,
+                                         PipelineConfig, ServeConfig)
+    from parallel_cnn_tpu.nn import layers as L
+    from parallel_cnn_tpu.nn.core import Sequential
+    from parallel_cnn_tpu.parallel import mesh as mesh_lib
+    from parallel_cnn_tpu.resilience.chaos import ChaosMonkey
+    from parallel_cnn_tpu.serve import (AutoScaler, CapacityModel, get,
+                                        scenarios, serve_stack)
+    from parallel_cnn_tpu.train import zoo
+    from parallel_cnn_tpu.train.pipeline_schedule import make_pipeline_step
+
+    n_dev = len(jax.devices())
+    if n_dev < 8:
+        raise RuntimeError(
+            f"--suite autotune needs >=8 devices (got {n_dev}); run under "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count=8"
+        )
+
+    rows: List[Row] = []
+    failures: List[str] = []
+
+    # -- leg 1: measured ranking vs the model (cpu-emu profile) ----------
+    model_fn = lambda: Sequential([  # noqa: E731 — fresh params per leg
+        L.Conv2D(4, (3, 3)), L.ReLU(), L.MaxPool(),
+        L.Flatten(), L.Dense(10),
+    ])
+    in_shape = (8, 8, 3)
+    global_batch = 64
+    mp = at.profile_module(model_fn(), in_shape, name="bench_cnn")
+    hw = hw_profiles.get_profile("cpu-emu")
+
+    # CPU-measurable dimensions only; index 2 (k4-s2) doubles as the
+    # hand-set "untuned default" row the chosen plan must beat.
+    cands = (
+        at.Plan(comm_impl="ring", wire_dtype="float32", overlap=True,
+                accum=2),
+        at.Plan(comm_impl="ring", wire_dtype="float32", overlap=True,
+                accum=8),
+        at.Plan(comm_impl="ring", wire_dtype="float32", overlap=False,
+                accum=4, stages=2),
+        at.Plan(comm_impl="ring", wire_dtype="float32", overlap=False,
+                accum=4, stages=4),
+    )
+    default_idx = 2
+    predicted = [
+        at.score_plan(p, mp, hw, global_batch=global_batch,
+                      n_dev=n_dev).img_s
+        for p in cands
+    ]
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(global_batch, *in_shape)).astype(np.float32)
+    Y = rng.integers(0, 10, size=(global_batch,)).astype(np.int32)
+
+    measured: List[float] = []
+    for p in cands:
+        model = model_fn()
+        comm = CommConfig(impl="ring", wire_dtype="float32",
+                          overlap=p.overlap)
+        opt = zoo.make_optimizer(0.1, momentum=0.9)
+        if p.stages > 1:
+            mesh = mesh_lib.make_pipeline_mesh(p.stages)
+            step = make_pipeline_step(
+                model, opt, accum_steps=p.accum, mesh=mesh,
+                pipeline=PipelineConfig(stages=p.stages),
+                in_shape=in_shape, comm=comm,
+            )
+        else:
+            mesh = mesh_lib.make_mesh(MeshConfig(data=n_dev, model=1))
+            step = zoo.make_train_step(
+                model, opt, accum_steps=p.accum, mesh=mesh, comm=comm
+            )
+
+        def thunk(carry, step=step, mesh=mesh, model=model):
+            if carry is None:
+                o = zoo.make_optimizer(0.1, momentum=0.9)
+                st = mesh_lib.replicate(
+                    mesh, zoo.init_state(model, jax.random.key(7),
+                                         in_shape, o)
+                )
+            else:
+                st = carry[0]
+            return step(st, jnp.asarray(X), jnp.asarray(Y))
+
+        sec = _sync_time(thunk, repeats=3 if quick else 10)
+        measured.append(global_batch / sec)
+
+    for p, pred, meas in zip(cands, predicted, measured):
+        rows.append(Row(
+            f"autotune_img_s_{p.label()}", round(meas, 1), "img/sec",
+            None, f"model predicts {pred:.0f} img/s (cpu-emu)",
+        ).finish())
+
+    gate_ok, summary = at.order_gate(predicted, measured)
+    if not gate_ok:
+        failures.append(f"ranking: {summary}")
+    # Anti-vacuity: inverting every prediction (1/x keeps the separation
+    # ratios, flips the order) must fail the same gate.
+    doctored_ok, _ = at.order_gate([1.0 / v for v in predicted], measured)
+    if doctored_ok:
+        failures.append(
+            "ranking: the doctored (inverted) table PASSED the order "
+            "gate — the gate is vacuous"
+        )
+    best_idx = max(range(len(cands)), key=lambda i: predicted[i])
+    if measured[best_idx] < measured[default_idx]:
+        failures.append(
+            f"chosen plan {cands[best_idx].label()} measured "
+            f"{measured[best_idx]:.0f} img/s, below the untuned default "
+            f"{cands[default_idx].label()} at {measured[default_idx]:.0f}"
+        )
+    rows.append(Row(
+        "autotune_rank_agreement", 1.0 if gate_ok else 0.0, "gate",
+        None, f"{summary}; doctored table "
+              f"{'FAILED (good)' if not doctored_ok else 'passed (BAD)'}",
+    ).finish())
+
+    # -- leg 2: predictive scale-up before any shed ----------------------
+    handle = get("lenet_ref")
+    obs_dir = tempfile.mkdtemp(prefix="pcnn_autotune_obs_")
+    obs = obs_lib.from_config(
+        ObsConfig(trace=True, dir=obs_dir, jax_annotations=False),
+        run="autotune_pred",
+    )
+    # SLO far above CPU latency: the reactive classifier can never trip,
+    # so any scale-up is the predictive branch's.  Deep queue + generous
+    # admission budget: nothing sheds while the planner reacts.
+    cfg = ServeConfig(
+        model="lenet_ref", max_batch=8, max_wait_ms=1.0,
+        queue_depth=2048, admission=True, slo_ms=2000.0, window_s=1.0,
+    )
+    pool, batcher = serve_stack(
+        handle, cfg, obs=obs,
+        chaos=ChaosMonkey.from_spec("slow-replica@3:400"),
+    )
+    capacity = CapacityModel(batcher.admission, max_batch=cfg.max_batch,
+                             headroom=0.5)
+    scaler = AutoScaler(pool, batcher, min_replicas=1, max_replicas=2,
+                        slo_ms=cfg.slo_ms, hysteresis=2, cooldown_s=1.0,
+                        interval_s=0.05, capacity=capacity, obs=obs)
+    try:
+        with scaler:
+            rep = scenarios.run("flash-crowd", batcher, seed=7,
+                                p99_ms=2000.0)
+        snap = scaler.snapshot()
+    finally:
+        batcher.close()
+    arts = obs.finish()
+    events = obs_lib.read_journal(arts["journal"])
+    ups = [e for e in events if e["kind"] == "scale_up"]
+    first_up_seq = ups[0]["seq"] if ups else None
+    sheds_before = [
+        e for e in events if e["kind"] == "shed"
+        and (first_up_seq is None or e["seq"] < first_up_seq)
+    ]
+    rows.append(Row(
+        "autotune_predictive_flash_crowd", round(rep.shed_rate, 4),
+        "unrecovered shed rate",
+        baseline_src=(
+            f"scale_ups {snap['scale_ups']} "
+            f"(predictive {snap['predictive_ups']}), "
+            f"sheds before first scale-up {len(sheds_before)}, "
+            f"routable {snap['routable']}"
+        ),
+    ).finish())
+    if not rep.conservation_ok:
+        failures.append(f"predictive: conservation {rep.server}")
+    if not ups:
+        failures.append(
+            "predictive: no scale-up despite the armed straggler "
+            "collapsing the planner's service rate"
+        )
+    elif ups[0].get("reason") != "predictive":
+        failures.append(
+            f"predictive: first scale-up reason "
+            f"{ups[0].get('reason')!r}, not 'predictive' — the reactive "
+            "loop beat the planner"
+        )
+    if sheds_before:
+        failures.append(
+            f"predictive: {len(sheds_before)} sheds journaled BEFORE "
+            "the first scale-up (the planner was late)"
+        )
+    if rep.shed_rate != 0.0:
+        failures.append(
+            f"predictive: unrecovered shed rate {rep.shed_rate:.4f} "
+            "after the flash crowd"
+        )
+
+    if failures:
+        rows.append(Row(
+            "error_autotune_gate", -1.0, "error",
+            baseline_src="; ".join(failures),
+        ))
+    print(
+        "AUTOTUNE_GATE "
+        + ("PASS: measured ranking agrees with the cost model, doctored "
+           "table trips the gate, predictive scale-up landed before any "
+           "shed"
+           if not failures else "FAIL: " + "; ".join(failures)),
+        flush=True,
+    )
+    return rows
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -2191,7 +2433,7 @@ def main(argv=None) -> int:
         default="all",
         choices=["all", "lenet", "phases", "dp", "zoo", "parity", "ops",
                  "comm", "northstar", "serve", "net", "fused", "cost",
-                 "obs", "elastic", "pipeline"],
+                 "obs", "elastic", "pipeline", "autotune"],
     )
     args = ap.parse_args(argv)
 
@@ -2218,6 +2460,7 @@ def main(argv=None) -> int:
         "obs": bench_obs,
         "elastic": bench_elastic,
         "pipeline": bench_pipeline,
+        "autotune": bench_autotune,
     }
     picked = suites.values() if args.suite == "all" else [suites[args.suite]]
 

@@ -47,6 +47,7 @@ LIVE_DOCS = (
     "docs/static_analysis.md",
     "docs/observability.md",
     "docs/pipeline.md",
+    "docs/autotuning.md",
     "docs/future_work.md",
 )
 

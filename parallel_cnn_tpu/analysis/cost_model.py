@@ -39,6 +39,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from parallel_cnn_tpu.analysis import hw_profiles
 from parallel_cnn_tpu.analysis.diagnostics import Diagnostic, Severity
 from parallel_cnn_tpu.analysis.jaxpr_rules import EntrySpec, _sub_jaxprs
 
@@ -48,13 +49,16 @@ DEFAULT_COST_REPORT = _ANALYSIS_DIR / "cost_report.json"
 
 HOST_AXIS_NAME = "host"  # parallel/mesh.py HOST_AXIS — DCN hops
 
-# Analytic roofline constants (v5e-8-class chip; deliberately hardcoded —
-# the roofline is an analytic yardstick printed next to measured rows, not
-# a tunable): bf16 MXU peak, per-direction ICI link, and a 200 Gb/s DCN
-# NIC.  Only the RATIO matters for which term binds.
-PEAK_FLOPS = 197e12          # flop/s
-ICI_BYTES_PER_S = 9.0e10     # bytes/s
-DCN_BYTES_PER_S = 2.5e10     # bytes/s
+# Analytic roofline constants — resolved from analysis/hw_profiles.py
+# (PCNN_HW_PROFILE picks the chip; the default ``v5e-8`` row is
+# byte-identical to the historically inline numbers, so existing reports
+# are stable).  The module-level aliases pin the DEFAULT profile for code
+# that wants the fixed yardstick; the live roofline + report read the
+# *active* profile so one env var re-derives everything.
+_DEFAULT_HW = hw_profiles.get_profile(hw_profiles.DEFAULT_PROFILE)
+PEAK_FLOPS = _DEFAULT_HW.peak_flops          # flop/s
+ICI_BYTES_PER_S = _DEFAULT_HW.ici_bytes_per_s  # bytes/s
+DCN_BYTES_PER_S = _DEFAULT_HW.dcn_bytes_per_s  # bytes/s
 
 
 # ---------------------------------------------------------------------------
@@ -156,6 +160,9 @@ def expected_collective_bytes(spec: EntrySpec) -> Tuple[int, int]:
 
     - ring_overlap:  ICI (K+1)·(D−1)·E/D·w            (K RS + 1 grad AG)
     - hier_overlap:  ICI as ring; DCN (K+1)·(H−1)·E/(D·H)·w
+    - ring_post:     ICI 2·(D−1)·E/D·w — overlap=False: ONE post-
+      accumulation ring all-reduce (RS+AG), K-independent
+    - hier_post:     ICI as ring_post; DCN 2·(H−1)·E/(D·H)·w
     - zero2_ring:    ICI K·(D−1)·E/D·w + (D−1)·E/D·4  (param AG f32)
     - zero3_ring:    identical to zero2_ring (head gather instead of tail)
     - zero3_hier:    ICI as zero2; DCN K·(H−1)·E/(D·H)·w + (H−1)·E/(D·H)·4
@@ -176,6 +183,11 @@ def expected_collective_bytes(spec: EntrySpec) -> Tuple[int, int]:
         elif spec.kind == "hier_overlap":
             ici += (k + 1) * dev_pass * w
             dcn += (k + 1) * host_pass * w
+        elif spec.kind == "ring_post":
+            ici += 2 * dev_pass * w
+        elif spec.kind == "hier_post":
+            ici += 2 * dev_pass * w
+            dcn += 2 * host_pass * w
         elif spec.kind in ("zero2_ring", "zero3_ring"):
             ici += k * dev_pass * w + dev_pass * 4
         elif spec.kind == "zero3_hier":
@@ -209,13 +221,16 @@ def peak_hbm_bytes(spec: EntrySpec) -> int:
 
 
 def roofline_img_s(spec: EntrySpec, flops: int,
-                   ici: int, dcn: int) -> float:
+                   ici: int, dcn: int,
+                   hw: Optional[hw_profiles.HwProfile] = None) -> float:
     """Analytic images/s: the step's global batch over the slowest of the
-    compute, ICI, and DCN terms (each device computes flops/shards)."""
+    compute, ICI, and DCN terms (each device computes flops/shards).
+    ``hw`` defaults to the active ``PCNN_HW_PROFILE`` profile."""
+    hw = hw or hw_profiles.active_profile()
     shards = spec.n_dev * spec.n_host
-    t_compute = (flops / max(shards, 1)) / PEAK_FLOPS
-    t_ici = ici / ICI_BYTES_PER_S
-    t_dcn = dcn / DCN_BYTES_PER_S
+    t_compute = (flops / max(shards, 1)) / hw.peak_flops
+    t_ici = ici / hw.ici_bytes_per_s
+    t_dcn = dcn / hw.dcn_bytes_per_s
     t = max(t_compute, t_ici, t_dcn)
     return spec.images_per_step / t if t > 0 else float("inf")
 
@@ -307,29 +322,75 @@ def build_seeded_entry(name: str):
 # Baseline ratchet + report
 # ---------------------------------------------------------------------------
 
+COST_SCHEMA_VERSION = 1
+
+
+class CostSchemaError(ValueError):
+    """A cost artifact (baseline/report) carries the wrong schema version
+    — refuse to compare keys that may mean something else."""
+
+
+def _check_schema_version(data: Dict, path: Path) -> None:
+    got = data.get("version")
+    if got != COST_SCHEMA_VERSION:
+        raise CostSchemaError(
+            f"{Path(path).name}: schema version {got!r} != "
+            f"{COST_SCHEMA_VERSION}; stale artifact — regenerate it "
+            "(check --cost --update-cost-baseline, or `tune` for the "
+            "autotune section) instead of silently comparing wrong keys"
+        )
+
+
 def load_cost_baseline(path: Path) -> Dict[str, Dict[str, int]]:
+    """Ratchet baseline entries; missing file is an empty baseline, a
+    version-mismatched file raises :class:`CostSchemaError` loudly."""
     if not Path(path).exists():
         return {}
     data = json.loads(Path(path).read_text())
+    _check_schema_version(data, path)
     return dict(data.get("entries", {}))
 
 
+def load_cost_report(path: Path) -> Dict:
+    """The full cost report payload, schema-version checked (the
+    ``--autotune`` consumer and capacity planner go through this)."""
+    data = json.loads(Path(path).read_text())
+    _check_schema_version(data, path)
+    return data
+
+
 def save_cost_baseline(path: Path, entries: Dict[str, Dict[str, int]]) -> None:
-    payload = {"version": 1, "entries": entries}
+    payload = {"version": COST_SCHEMA_VERSION, "entries": entries}
     Path(path).write_text(json.dumps(payload, indent=2, sort_keys=True) + "\n")
 
 
-def write_cost_report(path: Path, rows: Dict[str, Dict]) -> None:
+def write_cost_report(path: Path, rows: Dict[str, Dict],
+                      autotune: Optional[Dict] = None) -> None:
+    """Write the report; an existing version-valid report's ``autotune``
+    section is carried over unless a fresh one is passed in, so `check
+    --cost` regeneration never clobbers the tuner's ranked table."""
+    path = Path(path)
+    if autotune is None and path.exists():
+        try:
+            prev = json.loads(path.read_text())
+        except (json.JSONDecodeError, OSError):
+            prev = {}
+        if prev.get("version") == COST_SCHEMA_VERSION:
+            autotune = prev.get("autotune")
+    hw = hw_profiles.active_profile()
     payload = {
-        "version": 1,
+        "version": COST_SCHEMA_VERSION,
         "constants": {
-            "peak_flops": PEAK_FLOPS,
-            "ici_bytes_per_s": ICI_BYTES_PER_S,
-            "dcn_bytes_per_s": DCN_BYTES_PER_S,
+            "hw_profile": hw.name,
+            "peak_flops": hw.peak_flops,
+            "ici_bytes_per_s": hw.ici_bytes_per_s,
+            "dcn_bytes_per_s": hw.dcn_bytes_per_s,
         },
         "entries": rows,
     }
-    Path(path).write_text(json.dumps(payload, indent=2, sort_keys=True) + "\n")
+    if autotune is not None:
+        payload["autotune"] = autotune
+    path.write_text(json.dumps(payload, indent=2, sort_keys=True) + "\n")
 
 
 def entry_costs(name: str, closed, spec: Optional[EntrySpec]) -> Dict:
@@ -422,7 +483,17 @@ def run_cost_rules(
                     ),
                 ))
 
-    baseline = load_cost_baseline(baseline_path)
+    try:
+        baseline = load_cost_baseline(baseline_path)
+    except CostSchemaError as exc:
+        diags.append(Diagnostic(
+            rule="cost-ratchet",
+            severity=Severity.ERROR,
+            file=str(baseline_path),
+            line=0,
+            message=str(exc),
+        ))
+        baseline = {}
     for name, row in rows.items():
         base = baseline.get(name)
         if not base:

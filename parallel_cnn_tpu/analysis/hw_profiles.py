@@ -1,0 +1,133 @@
+"""Named hardware roofline profiles (the accountant's constant tables).
+
+PR 8's cost accountant hardcoded one chip's roofline constants inline in
+``cost_model.py``; this module is the table those numbers now come from,
+so swapping the target hardware is a profile name, not a source edit.
+Every profile is an analytic *yardstick* — per-device peak matmul
+throughput, per-direction interconnect link bandwidth, NIC bandwidth,
+HBM capacity, and per-hop collective launch latency — not a measured
+calibration.  Only the RATIOS matter for which roofline term binds and
+for how the autotuner (analysis/autotune.py) ranks plans.
+
+Selection order: explicit ``get_profile(name)`` argument, else the
+``PCNN_HW_PROFILE`` environment variable, else :data:`DEFAULT_PROFILE`
+(``v5e-8``, whose numbers are byte-identical to the historical inline
+constants so every existing report stays stable).
+
+Profiles:
+
+- ``v5e-8``   — the historical default: v5e-8-class chip, bf16 MXU peak,
+  per-direction ICI link, 200 Gb/s DCN NIC.
+- ``v4``      — TPU v4-class: bigger MXU (275 Tflop/s bf16), 3D-torus
+  ICI link, 32 GiB HBM.  docs/kernel_authoring.md re-derives its
+  roofline crossover from this row.
+- ``cpu-emu`` — one *virtual* device of the 8-way host-CPU emulation the
+  test/bench tier runs on.  Compute and "link" numbers are deliberately
+  modest and comm-heavy so schedule-level differences (accumulation
+  factor, pipeline bubble) dominate the ranking the CPU can actually
+  measure (docs/autotuning.md "Ranking validation").
+- ``pcie-gpu`` — A100-class PCIe part: NVLink-ish intra-host links over
+  a 200 Gb/s NIC.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Optional
+
+_GIB = 1024 ** 3
+
+
+@dataclasses.dataclass(frozen=True)
+class HwProfile:
+    """One chip's analytic roofline row.
+
+    ``ici_hop_s`` / ``dcn_hop_s`` charge a fixed launch latency per ring
+    pass per bucket hop — the term that makes bucket size matter to the
+    autotuner (many small buckets pay many hops; see
+    docs/autotuning.md "Scoring").
+    """
+
+    name: str
+    description: str
+    peak_flops: float        # flop/s, per device (bf16 MXU peak)
+    ici_bytes_per_s: float   # bytes/s, per-direction intra-host link
+    dcn_bytes_per_s: float   # bytes/s, inter-host NIC
+    hbm_bytes: int           # per-device memory capacity (HBM budget)
+    ici_hop_s: float = 1.0e-6
+    dcn_hop_s: float = 25.0e-6
+
+    def __post_init__(self):
+        for field in ("peak_flops", "ici_bytes_per_s", "dcn_bytes_per_s",
+                      "hbm_bytes"):
+            if getattr(self, field) <= 0:
+                raise ValueError(f"{self.name}: {field} must be positive")
+
+
+PROFILES: Dict[str, HwProfile] = {
+    p.name: p
+    for p in (
+        HwProfile(
+            name="v5e-8",
+            description=("v5e-8-class chip (the historical inline "
+                         "constants): bf16 MXU peak, per-direction ICI "
+                         "link, 200 Gb/s DCN NIC"),
+            peak_flops=197e12,
+            ici_bytes_per_s=9.0e10,
+            dcn_bytes_per_s=2.5e10,
+            hbm_bytes=16 * _GIB,
+        ),
+        HwProfile(
+            name="v4",
+            description=("TPU v4-class: 275 Tflop/s bf16 MXU, 3D-torus "
+                         "per-direction ICI link, 32 GiB HBM"),
+            peak_flops=275e12,
+            ici_bytes_per_s=1.0e11,
+            dcn_bytes_per_s=2.5e10,
+            hbm_bytes=32 * _GIB,
+        ),
+        HwProfile(
+            name="cpu-emu",
+            description=("one virtual device of the 8-way host-CPU "
+                         "emulation: modest compute, comm-heavy ratios "
+                         "so schedule-level differences dominate"),
+            peak_flops=5e9,
+            ici_bytes_per_s=2e9,
+            dcn_bytes_per_s=1e9,
+            hbm_bytes=2 * _GIB,
+            ici_hop_s=5.0e-6,
+            dcn_hop_s=50.0e-6,
+        ),
+        HwProfile(
+            name="pcie-gpu",
+            description=("A100-class PCIe part: NVLink-ish intra-host "
+                         "links, 200 Gb/s NIC, 40 GiB HBM"),
+            peak_flops=312e12,
+            ici_bytes_per_s=2.0e11,
+            dcn_bytes_per_s=2.5e10,
+            hbm_bytes=40 * _GIB,
+        ),
+    )
+}
+
+DEFAULT_PROFILE = "v5e-8"
+
+
+def get_profile(name: Optional[str] = None) -> HwProfile:
+    """Resolve a profile by name; ``None``/empty falls back to the
+    ``PCNN_HW_PROFILE`` env var, then :data:`DEFAULT_PROFILE`.  Unknown
+    names fail loudly with the full menu."""
+    resolved = name or os.environ.get("PCNN_HW_PROFILE") or DEFAULT_PROFILE  # graftcheck: disable=env-outside-config -- deliberate: the profile must resolve identically for EVERY consumer (cost model, tuner, check --cost), including paths that never build a Config; AutotuneConfig intentionally does not duplicate it (docs/autotuning.md)
+    try:
+        return PROFILES[resolved]
+    except KeyError:
+        raise ValueError(
+            f"unknown hardware profile {resolved!r} "
+            f"(known: {', '.join(sorted(PROFILES))})"
+        ) from None
+
+
+def active_profile() -> HwProfile:
+    """The profile the current process resolves to (env-aware)."""
+    return get_profile(None)

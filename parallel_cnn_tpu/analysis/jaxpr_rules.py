@@ -460,6 +460,75 @@ def _activation_hwm(model, params, mstate, microbatch: int,
     return peak
 
 
+def trace_tuned_entry(plan, mp, model, mesh, in_shape, global_batch: int,
+                      hbm_budget: Optional[int] = None) -> Tuple:
+    """Trace a tuner-chosen FLAT plan as a first-class cost entry.
+
+    The HBM budget gate runs FIRST: an over-budget plan raises
+    ``autotune.BudgetExceeded`` before any step is built, so a mutant
+    plan the tuner must reject can never leak into the traced entry set
+    (the anti-vacuity contract of the ``tune.chosen_plan`` entry).
+
+    Supports the flat single-host ZeRO-0 schedules
+    ``autotune.choose_for_trace`` searches over: psum (monolithic
+    all-reduce — no closed-form ppermute table, spec None) and the ring
+    in both overlap modes (kinds ``ring_overlap`` / ``ring_post``).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from parallel_cnn_tpu.analysis import autotune as autotune_lib
+    from parallel_cnn_tpu.config import CommConfig
+    from parallel_cnn_tpu.parallel import collectives
+    from parallel_cnn_tpu.train import zoo
+
+    n_data = mesh.shape["data"]
+    autotune_lib.assert_within_budget(
+        plan, mp, global_batch=global_batch, n_dev=n_data,
+        hbm_budget=hbm_budget,
+    )
+    if plan.stages != 1 or plan.zero or plan.comm_impl == "hierarchical":
+        raise ValueError(
+            f"trace_tuned_entry covers flat ZeRO-0 plans, got "
+            f"{plan.label()}"
+        )
+    micro = global_batch // (n_data * plan.accum)
+    comm = (None if plan.comm_impl == "psum" else CommConfig(
+        impl="ring", bucket_bytes=plan.bucket_bytes,
+        wire_dtype=plan.wire_dtype, overlap=plan.overlap,
+    ))
+    opt = zoo.make_optimizer(0.01, momentum=0.9)
+    st = zoo.init_state(model, jax.random.key(1), in_shape, opt)
+    tstep = zoo.make_train_step(
+        model, opt, accum_steps=plan.accum, mesh=mesh, comm=comm,
+    )
+    tx = jnp.zeros((global_batch, *in_shape), jnp.float32)
+    ty = jnp.zeros((global_batch,), jnp.int32)
+    closed = jax.make_jaxpr(tstep)(st, tx, ty)
+    if comm is None:
+        return ("tune.chosen_plan", closed, None)
+    bplan = collectives.plan_buckets(
+        st.params, comm.bucket_bytes, shards=n_data
+    )
+    kind = ("ring_overlap" if plan.overlap and plan.accum > 1
+            else "ring_post")
+    return (
+        "tune.chosen_plan",
+        closed,
+        EntrySpec(
+            kind=kind, n_dev=n_data, n_host=1, accum=plan.accum,
+            wire_itemsize=2 if plan.wire_dtype == "bfloat16" else 4,
+            bucket_elems=tuple(bplan.bucket_sizes),
+            resident_bytes=_tree_bytes(st),
+            act_bytes=_activation_hwm(
+                model, st.params, st.model_state, micro, tuple(in_shape), 4
+            ),
+            images_per_step=global_batch,
+            n_state_leaves=len(jax.tree_util.tree_leaves(st)),
+        ),
+    )
+
+
 def trace_entry_points(
     fast: bool = False, with_specs: bool = False
 ) -> List[Tuple]:
@@ -643,6 +712,24 @@ def trace_entry_points(
                 n_state_leaves=len(jax.tree_util.tree_leaves(zst)),
                 transient_gather_bytes=sum(zplan.bucket_sizes) * 4,
             ),
+        ))
+
+        # Autotuner chosen-plan entry (analysis/autotune.py): the flat
+        # winner of the DEFAULT-profile roofline search, re-traced so the
+        # plan the tuner recommends passes every jaxpr/cost rule the
+        # hand-set entries do.  The HBM budget gate inside
+        # trace_tuned_entry runs before the trace — an over-budget plan
+        # is rejected by the tuner, never traced.
+        from parallel_cnn_tpu.analysis import autotune as autotune_lib
+
+        tuned_mp = autotune_lib.profile_module(
+            model, cifar.IN_SHAPE, name="cifar_cnn"
+        )
+        tuned = autotune_lib.choose_for_trace(
+            tuned_mp, n_dev=n_data, global_batch=8 * n_data
+        )
+        out.append(trace_tuned_entry(
+            tuned.plan, tuned_mp, model, mesh, cifar.IN_SHAPE, 8 * n_data
         ))
 
     # Pipeline 1F1B entries (train/pipeline_schedule.py): the (stage,

@@ -19,6 +19,7 @@ from typing import List, Optional
 from parallel_cnn_tpu import obs as obs_lib
 from parallel_cnn_tpu.config import (
     AsyncConfig,
+    AutotuneConfig,
     CommConfig,
     Config,
     DataConfig,
@@ -63,8 +64,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="zoo models only: on-device random crop + "
                         "horizontal flip (CIFAR recipe), traced into the "
                         "train step")
-    p.add_argument("--accum-steps", type=int, default=1,
-                   help="zoo models only: gradient-accumulation microbatches")
+    # None sentinel: the autotuner's chosen plan may fill it; unset and
+    # untuned resolves to 1 (the historical no-accumulation default).
+    p.add_argument("--accum-steps", type=int, default=None,
+                   help="zoo models only: gradient-accumulation "
+                        "microbatches (default 1; --autotune may set it)")
     p.add_argument("--zoo-loader", default="device",
                    choices=["device", "native"],
                    help="zoo models only: batch source — on-device gathers "
@@ -135,6 +139,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "unset): derive one host row per jax.distributed "
                         "process; an explicit N splits one process's "
                         "devices into N emulated hosts (CPU testing)")
+    p.add_argument("--autotune", action="store_true",
+                   help="zoo mesh runs: apply the cost report's chosen "
+                        "parallelism plan (analysis/autotune.py; run "
+                        "`python -m parallel_cnn_tpu tune` first) as the "
+                        "base layer — explicit --comm-*/--fused-step/"
+                        "--pipeline-*/--accum-steps knobs still win "
+                        "[PCNN_AUTOTUNE]")
+    p.add_argument("--autotune-report", default=None, metavar="PATH",
+                   help="cost report the chosen plan is read from "
+                        "(default analysis/cost_report.json) "
+                        "[PCNN_AUTOTUNE_REPORT]")
     p.add_argument("--pipeline-stages", type=int, default=None, metavar="S",
                    help="zoo mesh runs: pipeline parallelism — partition "
                         "the model's layers over S stages of a (stage, "
@@ -442,10 +457,58 @@ def config_from_args(args: argparse.Namespace) -> Config:
                        if args.easgd_rho is not None
                        else base.easgd_rho),
         )
+    # --autotune / PCNN_AUTOTUNE*: env sets the base, flags override —
+    # then the report's chosen plan becomes the LOWEST layer: it fills
+    # every parallelism subsystem (comm / fused / pipeline /
+    # --accum-steps) the env and flags left untouched, so the tuner
+    # proposes and explicit knobs always win (plan < env < flags).
+    autotune = AutotuneConfig.from_env()
+    if args.autotune or args.autotune_report is not None:
+        base = autotune or AutotuneConfig()
+        autotune = dataclasses.replace(
+            base,
+            enabled=True,
+            report=args.autotune_report or base.report,
+        )
+    if autotune is not None and autotune.enabled:
+        # analysis.autotune is import-light (no jax at module scope), so
+        # this stays safe before the backend bootstrap.
+        from parallel_cnn_tpu.analysis import autotune as autotune_lib
+
+        try:
+            plan, section = autotune_lib.load_chosen_plan(autotune.report)
+        except ValueError as exc:  # NoFeasiblePlan / CostSchemaError
+            raise SystemExit(f"--autotune: {exc}")
+        n_host = int(section.get("n_host", 1) or 1)
+        plan_comm, plan_fused, plan_pipe, plan_accum = \
+            autotune_lib.plan_to_configs(plan, n_host=n_host)
+        if comm is None:
+            comm = plan_comm
+        if fused is None:
+            fused = plan_fused
+        if pipeline is None:
+            pipeline = plan_pipe
+        if args.accum_steps is None:
+            args.accum_steps = plan_accum
+        # The (n_dev, n_host) shape the tuner scored is part of the plan,
+        # so the mesh is filled like any other unset knob: a flat
+        # single-stage plan activates pure DP over the scored device
+        # count. Pipeline and hierarchical plans build their own meshes
+        # in the zoo driver (which reads args.mesh_data), and the lenet
+        # reference path has no mesh to activate.
+        if (args.mesh_data is None and (args.mesh_model or 1) == 1
+                and args.model != "lenet_ref"
+                and (pipeline is None or pipeline.stages == 1)
+                and (comm is None or comm.impl != "hierarchical")):
+            plan_dev = int(section.get("n_dev", 0) or 0)
+            if plan_dev > 1:
+                args.mesh_data = plan_dev
+                mesh = dataclasses.replace(mesh, data=plan_dev)
     return Config(data=data, train=train, mesh=mesh,
                   resilience=resilience, comm=comm, fused=fused,
                   obs=_obs_config_from_args(args), elastic=elastic,
-                  async_dp=async_dp, pipeline=pipeline, model=args.model)
+                  async_dp=async_dp, pipeline=pipeline,
+                  autotune=autotune, model=args.model)
 
 
 def build_serve_parser(cmd: str) -> argparse.ArgumentParser:
@@ -948,6 +1011,101 @@ def _run_check(argv: List[str]) -> int:
     return checker.main(argv)
 
 
+def _run_tune(argv: List[str]) -> int:
+    """`python -m parallel_cnn_tpu tune` — rank the parallelism-plan
+    space against the analytic roofline and write the chosen plan into
+    the cost report (docs/autotuning.md).
+
+    Search is pure closed-form arithmetic; jax is needed only to profile
+    the model (param/flop/activation tables), so CPU is forced with 8
+    virtual devices exactly like `check` — the tuner must run on a
+    devbox, not burn accelerator time."""
+    flags = os.environ.get("XLA_FLAGS", "")  # graftcheck: disable=env-outside-config -- backend bootstrap, must precede jax import; not a tunable knob
+    if "xla_force_host_platform_device_count" not in flags:
+        # graftcheck: disable=env-outside-config -- backend bootstrap, must precede jax import; not a tunable knob
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # backend already initialized (embedded call): profile as-is
+
+    from parallel_cnn_tpu.analysis import autotune as autotune_lib
+    from parallel_cnn_tpu.analysis import hw_profiles
+
+    at = AutotuneConfig.from_env() or AutotuneConfig()
+    p = argparse.ArgumentParser(
+        prog="parallel_cnn_tpu tune",
+        description="cost-model plan autotuner (analysis/autotune.py)",
+    )
+    p.add_argument("--model", default="cifar_cnn",
+                   choices=["cifar_cnn", "resnet18", "resnet34", "resnet50",
+                            "vgg16"],
+                   help="zoo model the plan space is profiled for")
+    p.add_argument("--global-batch", type=int, default=128, metavar="B",
+                   help="global batch size every plan must serve")
+    p.add_argument("--devices", type=int, default=None, metavar="N",
+                   help="device count the plans are laid out over "
+                        "(default: all local devices)")
+    p.add_argument("--hosts", type=int, default=1, metavar="H",
+                   help="emulated host count (hierarchical plans need "
+                        ">= 2; flat rings spanning hosts are charged at "
+                        "DCN speed)")
+    p.add_argument("--hw", default=at.hw, metavar="NAME",
+                   help="hardware profile scored against "
+                        f"({', '.join(sorted(hw_profiles.PROFILES))}) "
+                        "[PCNN_HW_PROFILE]")
+    p.add_argument("--hbm-budget-mb", type=float, default=None, metavar="MB",
+                   help="peak-HBM budget per device; default: the "
+                        "profile's capacity [PCNN_AUTOTUNE_HBM_BUDGET]")
+    p.add_argument("--top-k", type=int, default=at.top_k,
+                   help="ranked plans kept in the report "
+                        "[PCNN_AUTOTUNE_TOPK]")
+    p.add_argument("--report", default=at.report, metavar="PATH",
+                   help="cost report the autotune section is merged into; "
+                        "default: the shipped analysis/cost_report.json "
+                        "[PCNN_AUTOTUNE_REPORT]")
+    p.add_argument("--no-prune", action="store_true",
+                   help="score every feasible plan (disable the "
+                        "admissible compute-lower-bound prune; results "
+                        "are identical by construction — debug only)")
+    args = p.parse_args(argv)
+
+    from parallel_cnn_tpu.nn import cifar, resnet, vgg
+
+    factories = {
+        "cifar_cnn": lambda: cifar.cifar_cnn(),
+        "resnet18": lambda: resnet.resnet18(10, cifar_stem=True),
+        "resnet34": lambda: resnet.resnet34(10, cifar_stem=True),
+        "resnet50": lambda: resnet.resnet50(10, cifar_stem=True),
+        "vgg16": lambda: vgg.vgg16(10),
+    }
+    model = factories[args.model]()
+    mp = autotune_lib.profile_module(model, cifar.IN_SHAPE, name=args.model)
+    hw = hw_profiles.get_profile(args.hw)
+    n_dev = args.devices or jax.local_device_count()
+    budget = (int(args.hbm_budget_mb * 1024 * 1024)
+              if args.hbm_budget_mb is not None else at.hbm_budget)
+    try:
+        result = autotune_lib.search(
+            mp, hw=hw, global_batch=args.global_batch, n_dev=n_dev,
+            n_host=args.hosts, hbm_budget=budget, top_k=args.top_k,
+            prune=not args.no_prune,
+        )
+    except autotune_lib.NoFeasiblePlan as exc:
+        print(f"tune: {exc}")
+        return 1
+    print(autotune_lib.format_table(result))
+    written = autotune_lib.write_section(
+        args.report, autotune_lib.build_section(result))
+    print(f"tune: chosen plan written to {written}")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import sys
 
@@ -960,6 +1118,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _run_serve(raw[0], raw[1:])
     if raw and raw[0] == "check":
         return _run_check(raw[1:])
+    if raw and raw[0] == "tune":
+        return _run_tune(raw[1:])
     args = build_parser().parse_args(raw)
     cfg = config_from_args(args)
 
@@ -1270,7 +1430,7 @@ def _run_zoo(args: argparse.Namespace, cfg: Config) -> int:
             lr_schedule=args.lr_schedule,
             warmup_steps=args.warmup_steps,
             augment=args.augment,
-            accum_steps=args.accum_steps,
+            accum_steps=args.accum_steps or 1,
             mesh=mesh,
             model_axis=model_axis,
             comm=cfg.comm,

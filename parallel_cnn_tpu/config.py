@@ -820,6 +820,72 @@ class PipelineConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class AutotuneConfig:
+    """Cost-model autotuner policy (analysis/autotune.py — enumerate the
+    legal parallelism-plan space, score every plan against the analytic
+    roofline under a hard peak-HBM budget, and apply the winner;
+    docs/autotuning.md has the search space and the scoring formula).
+
+    The default (no AutotuneConfig at all — Config.autotune is None)
+    keeps plan selection fully manual: every --comm-impl/--zero/
+    --pipeline-stages flag means exactly what the operator typed.
+    Constructing one (--autotune / PCNN_AUTOTUNE=1) layers the report's
+    chosen plan UNDER the env and CLI flags — the tuner proposes,
+    explicit knobs still win.
+    """
+
+    enabled: bool = True
+    # Cost report the chosen plan is read from (``tune`` writes it; see
+    # analysis/autotune.py load_chosen_plan). None = the shipped report,
+    # cost_model.DEFAULT_COST_REPORT — resolved at use, not here, so the
+    # dataclass stays importable without the analysis package.
+    report: Optional[str] = None
+    # Hardware profile name (analysis/hw_profiles.py) the tuner scores
+    # against; None = the PCNN_HW_PROFILE env var, then the default.
+    hw: Optional[str] = None
+    # Ranked plans kept in the report table.
+    top_k: int = 8
+    # Peak-HBM budget in bytes a plan must fit under; None = the
+    # profile's full HBM capacity.
+    hbm_budget: Optional[int] = None
+
+    def __post_init__(self):
+        if self.top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {self.top_k}")
+        if self.hbm_budget is not None and self.hbm_budget <= 0:
+            raise ValueError(
+                f"hbm_budget must be > 0, got {self.hbm_budget}"
+            )
+        if self.hw is not None:
+            # Fail at config time, not mid-search; hw_profiles is
+            # import-light (no jax) so this stays cheap.
+            from parallel_cnn_tpu.analysis import hw_profiles
+            hw_profiles.get_profile(self.hw)
+
+    @staticmethod
+    def from_env() -> Optional["AutotuneConfig"]:
+        """AutotuneConfig from PCNN_AUTOTUNE / PCNN_AUTOTUNE_REPORT /
+        PCNN_AUTOTUNE_TOPK / PCNN_AUTOTUNE_HBM_BUDGET, or None when none
+        of them is set (→ fully manual plan selection). The hardware
+        profile is NOT duplicated here — PCNN_HW_PROFILE is resolved by
+        analysis/hw_profiles.get_profile for every consumer."""
+        enabled = os.environ.get("PCNN_AUTOTUNE")
+        report = os.environ.get("PCNN_AUTOTUNE_REPORT")
+        top_k = os.environ.get("PCNN_AUTOTUNE_TOPK")
+        budget = os.environ.get("PCNN_AUTOTUNE_HBM_BUDGET")
+        if (enabled is None and report is None and top_k is None
+                and budget is None):
+            return None
+        return AutotuneConfig(
+            enabled=(enabled if enabled is not None else "1")
+            not in ("0", ""),
+            report=report or None,
+            top_k=int(top_k) if top_k else 8,
+            hbm_budget=int(budget) if budget else None,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
@@ -852,6 +918,9 @@ class Config:
     # None = in-process serving only; a NetConfig opts the serve stack
     # into the supervised TCP front door (serve/net.py + supervisor.py).
     net: Optional[NetConfig] = None
+    # None = manual plan selection; an AutotuneConfig layers the cost
+    # report's chosen plan under the env/CLI knobs (analysis/autotune.py).
+    autotune: Optional[AutotuneConfig] = None
     model: str = "lenet_ref"
 
     def replace(self, **kw) -> "Config":

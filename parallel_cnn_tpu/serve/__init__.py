@@ -13,7 +13,10 @@ north star; docs/serving.md for the design).
     admission  SLO admission control: EWMA reject-early shedding +
                the graceful-degradation ladder (AdmissionController)
     autoscaler hysteresis/cooldown control loop growing/draining the
-               ReplicaPool from windowed telemetry (AutoScaler)
+               ReplicaPool from windowed telemetry, with an optional
+               predictive feed-forward branch (AutoScaler)
+    capacity   predictive capacity planner: chosen serve plan +
+               admission EWMAs → replicas-needed (CapacityModel)
     scenarios  seeded traffic scenarios with explicit p99/shed gates
                (diurnal, flash-crowd, slow-client, chaos-kill/slow)
                plus the net suites judged at the wire tier
@@ -28,6 +31,7 @@ north star; docs/serving.md for the design).
 
 from parallel_cnn_tpu.serve.admission import AdmissionController  # noqa: F401
 from parallel_cnn_tpu.serve.autoscaler import AutoScaler  # noqa: F401
+from parallel_cnn_tpu.serve.capacity import CapacityModel  # noqa: F401
 from parallel_cnn_tpu.serve.batcher import (  # noqa: F401
     DeadlineExceeded,
     DynamicBatcher,

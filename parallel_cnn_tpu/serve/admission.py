@@ -81,6 +81,8 @@ class AdmissionController:
         self._level = 0
         self._queue_wait_ewma: Optional[float] = None   # seconds
         self._service_ewma: Dict[int, float] = {}       # bucket → seconds
+        self._last_arrival: Optional[float] = None      # monotonic seconds
+        self._interarrival_ewma: Optional[float] = None  # seconds between
         self._admitted = 0
         self._rejected_late = 0
         self._rejected_ladder = 0
@@ -105,6 +107,30 @@ class AdmissionController:
                 service_s if prev is None
                 else prev + self.ewma_alpha * (service_s - prev)
             )
+
+    def _observe_arrival(self, now: float) -> None:
+        """Demand feedback: every submit (admitted OR shed — offered
+        load is the signal, not carried load) updates the interarrival
+        EWMA the capacity planner reads through ``arrival_rate``."""
+        with self._lock:
+            last = self._last_arrival
+            self._last_arrival = now
+            if last is None:
+                return
+            dt = max(now - last, 1e-6)  # same-tick bursts still count
+            prev = self._interarrival_ewma
+            self._interarrival_ewma = (
+                dt if prev is None
+                else prev + self.ewma_alpha * (dt - prev)
+            )
+
+    def arrival_rate(self) -> float:
+        """Offered load in requests/s (1 / interarrival EWMA); 0.0 until
+        two arrivals have been seen — a cold estimate predicts nothing,
+        so the capacity planner falls back to the reactive loop."""
+        with self._lock:
+            ia = self._interarrival_ewma
+            return 1.0 / ia if ia else 0.0
 
     def predicted_wait_s(self) -> float:
         """Expected submit→result time for a request admitted now:
@@ -174,6 +200,7 @@ class AdmissionController:
         ``deadline`` is absolute monotonic seconds (None → the
         controller's own slo_ms budget is the objective)."""
         now = self._clock() if now is None else now
+        self._observe_arrival(now)
         level = self._update_level(queue_depth)
         if level >= 3 and priority == "best-effort":
             with self._lock:
@@ -213,6 +240,10 @@ class AdmissionController:
                 "service_ewma_ms": {
                     b: 1e3 * s for b, s in self._service_ewma.items()
                 },
+                "arrival_rate_rps": (
+                    1.0 / self._interarrival_ewma
+                    if self._interarrival_ewma else 0.0
+                ),
             }
 
     def attach_registry(self, registry, prefix: str = "admission") -> None:

@@ -22,9 +22,23 @@ becomes unroutable (``pool.drain``), the loop waits for its in-flight
 count to reach zero (``batcher.inflight``), then frees the slot —
 zero in-flight requests are lost by construction.
 
+The reactive loop is by construction *late*: it waits for a symptom
+(p99 bust, shed) and then pays hysteresis ticks.  Passing a
+``capacity`` planner (serve/capacity.py) adds a **predictive**
+feed-forward branch: when the planner's replicas-needed estimate —
+arrival-rate EWMA over per-replica service rate, with headroom —
+exceeds the routable count, the loop scales up immediately, *before*
+the windowed p99 busts the SLO.  The predictive branch skips
+hysteresis (the EWMAs are the noise filter) but still honours the
+cooldown and ``max_replicas``; while the planner is cold it returns
+``None`` and the reactive classifier is the only voice.  With
+``capacity=None`` the loop is exactly the PR 11 reactive scaler.
+
 Every decision lands in the event journal (``scale_up`` /
-``scale_down`` events) and the metrics registry (``attach_registry``),
-so a capacity timeline is reconstructable from the obs artifacts.
+``scale_down`` events, each carrying the ``reason`` —
+"predictive"/"reactive") and the metrics registry
+(``attach_registry``), so a capacity timeline is reconstructable from
+the obs artifacts.
 
 ``tick()`` is the testable unit (no thread, injectable clock);
 ``start()``/``close()`` wrap it in the background control loop.
@@ -63,6 +77,7 @@ class AutoScaler:
         cooldown_s: float = 2.0,
         interval_s: float = 0.25,
         drain_timeout_s: float = 10.0,
+        capacity=None,
         obs: Optional["obs_lib.Obs"] = None,
         clock: Callable[[], float] = time.monotonic,
     ):
@@ -86,11 +101,15 @@ class AutoScaler:
         self.cooldown_s = cooldown_s
         self.interval_s = interval_s
         self.drain_timeout_s = drain_timeout_s
+        #: Optional serve.capacity.CapacityModel — enables the
+        #: predictive feed-forward branch of tick().
+        self.capacity = capacity
         self.obs = obs if obs is not None else obs_lib.NOOP
         self._clock = clock
         self._lock = threading.Lock()
         self._up_streak = 0
         self._down_streak = 0
+        self._predictive_ups = 0
         self._last_action_t: Optional[float] = None
         #: (t, direction, replica) decision log — tests replay it.
         self.actions: List[Tuple[float, str, int]] = []
@@ -117,6 +136,25 @@ class AutoScaler:
         None. Hysteresis and cooldown are enforced here, so calling
         tick() faster changes nothing but reaction latency."""
         now = self._clock()
+        # Feed-forward first: if the capacity planner predicts demand
+        # beyond the routable fleet, grow NOW — no hysteresis (the
+        # planner's EWMAs are the noise filter), but cooldown and
+        # max_replicas still bound the step.  A cold planner returns
+        # None and the reactive classifier below is the only voice.
+        if self.capacity is not None:
+            with self._lock:
+                in_cooldown = (
+                    self._last_action_t is not None
+                    and now - self._last_action_t < self.cooldown_s
+                )
+            if not in_cooldown:
+                needed = self.capacity.replicas_needed()
+                if needed is not None and needed > len(self.pool.routable()):
+                    acted = self._scale_up(now, reason="predictive")
+                    if acted is not None:
+                        with self._lock:
+                            self._predictive_ups += 1
+                        return acted
         want = self._classify()
         with self._lock:
             if want == "up":
@@ -149,7 +187,7 @@ class AutoScaler:
             self._down_streak = 0
             self.actions.append((now, direction, replica))
 
-    def _scale_up(self, now: float) -> Optional[str]:
+    def _scale_up(self, now: float, reason: str = "reactive") -> Optional[str]:
         if len(self.pool.routable()) >= self.max_replicas:
             return None
         i = self.pool.grow()
@@ -159,7 +197,7 @@ class AutoScaler:
             self.batcher.add_runner()
         self._record(now, "up", i)
         if self.obs.enabled:
-            self.obs.event("scale_up", replica=i,
+            self.obs.event("scale_up", replica=i, reason=reason,
                            routable=len(self.pool.routable()))
         return "up"
 
@@ -182,7 +220,7 @@ class AutoScaler:
         self.pool.retire(victim)
         self._record(now, "down", victim)
         if self.obs.enabled:
-            self.obs.event("scale_down", replica=victim,
+            self.obs.event("scale_down", replica=victim, reason="reactive",
                            routable=len(self.pool.routable()))
         return "down"
 
@@ -227,12 +265,14 @@ class AutoScaler:
         with self._lock:
             ups = sum(1 for _, d, _ in self.actions if d == "up")
             downs = sum(1 for _, d, _ in self.actions if d == "down")
+            predictive = self._predictive_ups
         return {
             "routable": len(self.pool.routable()),
             "min": self.min_replicas,
             "max": self.max_replicas,
             "scale_ups": ups,
             "scale_downs": downs,
+            "predictive_ups": predictive,
             "direction_changes": self.direction_changes(),
         }
 

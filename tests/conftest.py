@@ -133,3 +133,11 @@ def pytest_configure(config):
         "cache round-trip + corruption fallback, hot-swap zero-failed, "
         "NetConfig layering)",
     )
+    config.addinivalue_line(
+        "markers",
+        "autotune: cost-model autotuner + predictive capacity tests "
+        "(analysis/autotune.py, analysis/hw_profiles.py, "
+        "serve/capacity.py — brute-vs-pruned top-k equality, HBM-budget "
+        "exclusion, schema-version ratchet, plan-to-Config mapping, "
+        "arrival-rate EWMA, predictive scale-up before any shed)",
+    )
