@@ -20,6 +20,11 @@ Per-file rules (:func:`scan_module`):
 - ``donation-source``: a donating entry point (``batched_step`` et al.
   donate argument 0) is called and the donated buffer's name is read
   afterwards without rebinding — the classic read-after-donation UAF.
+- ``mesh-outside-plan``: a ``Mesh(...)`` / ``make_*_mesh(...)`` call
+  outside ``parallel_cnn_tpu/plan/`` (and the constructors' home,
+  ``parallel/mesh.py``).  Topology resolves through the ExecutionPlan
+  — the single mesh-construction site — so plan fingerprints stay
+  truthful; test/bench sites waive with a mandatory reason.
 
 Repo-level rules (:func:`env_doc_parity`, :func:`doc_xref`):
 
@@ -243,6 +248,48 @@ def scan_module(path: Path, tree: ast.Module, source: str) -> List[Diagnostic]:
                     line=hit.lineno,
                     message="os.environ read outside config.py; route the knob "
                             "through a *Config.from_env or waive with a reason",
+                ))
+
+    # --- mesh-outside-plan: mesh construction outside the plan layer ---
+    # The ExecutionPlan (parallel_cnn_tpu/plan/) is the ONE mesh
+    # resolution site: every `Mesh(...)` / `make_*_mesh(...)` call
+    # elsewhere builds topology the plan cannot see (fingerprints,
+    # checkpoint gating, and the elastic recompile-once cache all go
+    # blind). parallel/mesh.py itself (the constructors' home) is
+    # exempt; test/bench sites waive with a mandatory reason.
+    rel_posix = Path(rel).as_posix()
+    if not (
+        "parallel_cnn_tpu/plan" in rel_posix
+        or rel_posix.endswith("parallel/mesh.py")
+    ):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = dotted_name(node.func)
+            short = fn.split(".")[-1]
+            # `<plan>.make_mesh()` — a method call on an ExecutionPlan —
+            # IS the sanctioned site; only the mesh-module constructors
+            # (unique names, or `make_mesh` reached through the module)
+            # are rogue.
+            base = fn.rsplit(".", 1)[0] if "." in fn else ""
+            rogue_make_mesh = short == "make_mesh" and (
+                base in ("", "mesh", "mesh_lib")
+                or base.endswith("parallel.mesh")
+            )
+            if short in (
+                "Mesh", "make_hier_mesh", "make_pipeline_mesh",
+                "make_elastic_mesh", "single_device_mesh",
+            ) or rogue_make_mesh:
+                diags.append(Diagnostic(
+                    rule="mesh-outside-plan",
+                    severity=Severity.ERROR,
+                    file=rel,
+                    line=node.lineno,
+                    message=f"'{fn}(...)' constructs a mesh outside "
+                            "parallel_cnn_tpu/plan/; route topology through "
+                            "plan.build_plan(...).make_mesh() — the single "
+                            "resolution site — or waive with a reason at a "
+                            "test/bench site",
                 ))
 
     jits = jitted_functions(tree)
@@ -501,6 +548,7 @@ _DOC_MODULE_ALIASES = {
     "pallas_update": "parallel_cnn_tpu.ops.pallas_update",
     "pallas_tail": "parallel_cnn_tpu.ops.pallas_tail",
     "obs": "parallel_cnn_tpu.obs",
+    "plan": "parallel_cnn_tpu.plan",
 }
 _SYMBOL_RE = re.compile(r"`([a-z_][a-z0-9_]*)\.([a-z_][A-Za-z0-9_]*)\(")
 

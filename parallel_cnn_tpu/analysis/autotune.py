@@ -123,6 +123,58 @@ class Plan:
             out += ["--pipeline-stages", str(self.stages)]
         return out
 
+    def to_execution_plan(self, n_host: int = 1, n_dev: Optional[int] = None):
+        """The full :class:`plan.ExecutionPlan` this search point denotes
+        — autotune's Plan is a thin VIEW over the execution contract, so
+        tune → train is a lossless artifact hand-off.  Field expansion
+        matches :func:`plan_to_configs` exactly (the ``--autotune`` and
+        ``--plan`` train paths must resolve identical configs)."""
+        from parallel_cnn_tpu import plan as plan_lib
+
+        fused = self.zero > 0
+        hier = self.comm_impl == "hierarchical"
+        values = dict(
+            comm_impl=self.comm_impl,
+            bucket_bytes=self.bucket_bytes or 4 * _MIB,
+            wire_dtype=self.wire_dtype,
+            overlap=self.overlap or fused,
+            hosts=n_host if hier else None,
+            zero=self.zero,
+            fused=fused,
+            fused_update=fused,
+            act_dtype="bfloat16" if fused else "float32",
+            accum=self.accum,
+            pipelined=self.stages > 1,
+            stages=self.stages,
+        )
+        if n_dev and self.stages == 1 and not hier:
+            values["data"] = n_dev
+        if self.zero == 3:
+            values["param_sharding"] = "zero3"
+            values["opt_sharding"] = "zero3"
+        elif self.zero == 2:
+            values["opt_sharding"] = "zero3"
+        return plan_lib.ExecutionPlan(
+            **values,
+            provenance=tuple(sorted((k, "autotune") for k in values)),
+        )
+
+    @staticmethod
+    def from_execution_plan(eplan) -> "Plan":
+        """Project an ExecutionPlan back onto the search-space view
+        (canonical form — the don't-care axes collapse the same way
+        :func:`_canonical` collapses them)."""
+        return _canonical(Plan(
+            comm_impl=eplan.comm_impl or "psum",
+            bucket_bytes=eplan.bucket_bytes,
+            wire_dtype=eplan.wire_dtype,
+            overlap=eplan.overlap,
+            zero=eplan.zero,
+            accum=eplan.accum,
+            stages=eplan.stages,
+            fused=eplan.zero > 0,
+        ))
+
 
 @dataclasses.dataclass(frozen=True)
 class SearchSpace:

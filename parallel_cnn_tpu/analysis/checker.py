@@ -48,6 +48,7 @@ LIVE_DOCS = (
     "docs/observability.md",
     "docs/pipeline.md",
     "docs/autotuning.md",
+    "docs/execution_plan.md",
     "docs/future_work.md",
 )
 
@@ -190,6 +191,53 @@ def run_check(
     return exit_code, report, diags
 
 
+def verify_plan_file(
+    path: Path, cost_baseline_path: Optional[Path] = None
+) -> Tuple[int, str]:
+    """Statically verify a plan file without running it.
+
+    Loads the plan (schema-versioned JSON, including tune --report
+    output), runs the legality matrix (plan.validate()), and resolves
+    the plan's cost-table key against the cost ratchet baseline — so a
+    ``tune``-emitted or hand-written plan can be vetted offline before
+    any device time is spent.  Returns (exit_code, report).
+    """
+    from parallel_cnn_tpu import plan as plan_lib
+    from parallel_cnn_tpu.analysis import cost_model
+
+    try:
+        eplan = plan_lib.load_plan(path)
+    except (plan_lib.PlanSchemaError, plan_lib.PlanError, OSError,
+            ValueError) as e:
+        return 1, f"plan: FAIL {path}: {e}"
+    try:
+        eplan.validate()
+    except plan_lib.PlanError as e:
+        return 1, (f"plan: FAIL {path} (fingerprint "
+                   f"{eplan.fingerprint()}): {e}")
+    key, kind = eplan.cost_table_key()
+    entries = cost_model.load_cost_baseline(
+        cost_baseline_path or cost_model.DEFAULT_COST_BASELINE
+    )
+    lines = [
+        f"plan: OK {path}",
+        f"  fingerprint: {eplan.fingerprint()}",
+        f"  label: {plan_lib.format_plan(eplan)}",
+        f"  cost-table key: {key}"
+        + (f" (closed form: {kind})" if kind else ""),
+    ]
+    row = entries.get(key)
+    if row is not None:
+        budget = ", ".join(f"{k}={v}" for k, v in sorted(row.items()))
+        lines.append(f"  cost baseline: present ({budget})")
+    else:
+        lines.append(
+            f"  cost baseline: no entry for {key!r} — run "
+            "`check --cost` after tracing this topology to ratchet it"
+        )
+    return 0, "\n".join(lines)
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     """Entry point behind ``python -m parallel_cnn_tpu check``."""
     import argparse
@@ -227,11 +275,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="append a seeded mutant entry (bf16-master-gather, "
                          "partial-stage-ring) — the anti-vacuity leg of "
                          "the dryrun")
+    ap.add_argument("--plan", type=Path, default=None, metavar="PATH",
+                    help="verify an ExecutionPlan file statically (schema, "
+                         "legality matrix, cost-table key vs the cost "
+                         "baseline) without running it; skips the analyzer "
+                         "families")
     ap.add_argument("--json", type=Path, default=None, metavar="PATH",
                     help="also write diagnostics as JSON")
     ap.add_argument("--verbose", "-v", action="store_true",
                     help="include baselined and waived findings in the report")
     args = ap.parse_args(argv)
+
+    if args.plan is not None:
+        code, report = verify_plan_file(
+            args.plan, cost_baseline_path=args.cost_baseline
+        )
+        print(report)
+        return code
 
     code, report, diags = run_check(
         fast=args.fast,

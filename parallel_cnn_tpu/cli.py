@@ -32,6 +32,7 @@ from parallel_cnn_tpu.config import (
     ResilienceConfig,
     ServeConfig,
     TrainConfig,
+    plan_path_from_env,
 )
 
 
@@ -187,6 +188,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fused-step activation dtype (PCNN_ACT_DTYPE; "
                         "default bfloat16). Refines --fused-step only — "
                         "it never enables the fused path by itself")
+    p.add_argument("--plan", default=None, metavar="PATH",
+                   help="execution-plan file (docs/execution_plan.md; "
+                        "written by `tune --report` or `plan show --save`): "
+                        "fills every parallelism knob the env and explicit "
+                        "flags left unset — flag beats env beats plan "
+                        "[PCNN_PLAN]")
+    p.add_argument("--replan", action="store_true",
+                   help="allow resuming from a checkpoint whose recorded "
+                        "plan fingerprint mismatches the live plan "
+                        "(re-shard under the live plan instead of refusing "
+                        "with PlanMismatchError)")
     p.add_argument("--checkpoint-dir", default=None,
                    help="save ckpt_<epoch>.npz per epoch; --resume restarts "
                         "from the latest")
@@ -457,6 +469,50 @@ def config_from_args(args: argparse.Namespace) -> Config:
                        if args.easgd_rho is not None
                        else base.easgd_rho),
         )
+    # --plan / PCNN_PLAN: a serialized ExecutionPlan (written by `tune
+    # --report` or `plan show --save`) fills every parallelism knob the
+    # env and flags left unset — the same precedence slot as the
+    # autotuner's chosen plan (flag > env > plan > default), and knobs it
+    # fills are provenance-labeled "autotune" by plan.build_plan.
+    args._autotune_filled = set()
+    plan_path = getattr(args, "plan", None) or plan_path_from_env()
+    if plan_path:
+        from parallel_cnn_tpu import plan as plan_lib
+
+        try:
+            eplan = plan_lib.load_plan(plan_path)
+        except plan_lib.PlanError as exc:
+            raise SystemExit(f"--plan: {exc}")
+        if comm is None and eplan.comm_impl is not None:
+            comm = eplan.comm_config()
+            args._autotune_filled |= {
+                "comm_impl", "bucket_bytes", "wire_dtype", "overlap",
+                "hosts",
+            }
+        if fused is None and eplan.fused:
+            fused = eplan.fused_config()
+            args._autotune_filled |= {
+                "fused", "fused_update", "fused_tail", "act_dtype", "zero",
+            }
+        if pipeline is None and (ppc := eplan.pipeline_config()) is not None:
+            pipeline = ppc
+            args._autotune_filled |= {
+                "pipelined", "stages", "split", "pipe_wire_dtype",
+                "pipe_act_dtype",
+            }
+        if args.accum_steps is None and eplan.accum > 1:
+            args.accum_steps = eplan.accum
+            args._autotune_filled.add("accum")
+        if args.mesh_data is None and eplan.data is not None \
+                and not (eplan.pipelined or eplan.stages > 1
+                         or eplan.comm_impl == "hierarchical"):
+            args.mesh_data = eplan.data
+            mesh = dataclasses.replace(mesh, data=eplan.data)
+            args._autotune_filled.add("data")
+        if (args.mesh_model or 1) == 1 and eplan.model > 1:
+            args.mesh_model = eplan.model
+            mesh = dataclasses.replace(mesh, model=eplan.model)
+            args._autotune_filled.add("model")
     # --autotune / PCNN_AUTOTUNE*: env sets the base, flags override —
     # then the report's chosen plan becomes the LOWEST layer: it fills
     # every parallelism subsystem (comm / fused / pipeline /
@@ -482,14 +538,27 @@ def config_from_args(args: argparse.Namespace) -> Config:
         n_host = int(section.get("n_host", 1) or 1)
         plan_comm, plan_fused, plan_pipe, plan_accum = \
             autotune_lib.plan_to_configs(plan, n_host=n_host)
-        if comm is None:
+        if comm is None and plan_comm is not None:
             comm = plan_comm
-        if fused is None:
+            args._autotune_filled |= {
+                "comm_impl", "bucket_bytes", "wire_dtype", "overlap",
+                "hosts",
+            }
+        if fused is None and plan_fused is not None:
             fused = plan_fused
-        if pipeline is None:
+            args._autotune_filled |= {
+                "fused", "fused_update", "fused_tail", "act_dtype", "zero",
+            }
+        if pipeline is None and plan_pipe is not None:
             pipeline = plan_pipe
+            args._autotune_filled |= {
+                "pipelined", "stages", "split", "pipe_wire_dtype",
+                "pipe_act_dtype",
+            }
         if args.accum_steps is None:
             args.accum_steps = plan_accum
+            if plan_accum and plan_accum > 1:
+                args._autotune_filled.add("accum")
         # The (n_dev, n_host) shape the tuner scored is part of the plan,
         # so the mesh is filled like any other unset knob: a flat
         # single-stage plan activates pure DP over the scored device
@@ -504,6 +573,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             if plan_dev > 1:
                 args.mesh_data = plan_dev
                 mesh = dataclasses.replace(mesh, data=plan_dev)
+                args._autotune_filled.add("data")
     return Config(data=data, train=train, mesh=mesh,
                   resilience=resilience, comm=comm, fused=fused,
                   obs=_obs_config_from_args(args), elastic=elastic,
@@ -1102,8 +1172,80 @@ def _run_tune(argv: List[str]) -> int:
     print(autotune_lib.format_table(result))
     written = autotune_lib.write_section(
         args.report, autotune_lib.build_section(result))
-    print(f"tune: chosen plan written to {written}")
+    # Embed the chosen plan as a first-class ExecutionPlan document so
+    # the report itself is a --plan file — the lossless tune → train
+    # artifact hand-off (docs/execution_plan.md).
+    import json as json_mod
+
+    from parallel_cnn_tpu import plan as plan_lib
+
+    chosen, section = autotune_lib.load_chosen_plan(written)
+    eplan = chosen.to_execution_plan(
+        n_host=int(section.get("n_host", 1) or 1),
+        n_dev=int(section.get("n_dev", 0) or 0) or None,
+    )
+    with open(written) as f:
+        doc = json_mod.load(f)
+    doc["plan"] = eplan.to_json_dict()
+    with open(written, "w") as f:
+        json_mod.dump(doc, f, sort_keys=True, indent=2)
+        f.write("\n")
+    print(f"tune: chosen plan written to {written} "
+          f"(plan {eplan.fingerprint()}; run with --plan {written})")
     return 0
+
+
+def _run_plan(argv: List[str]) -> int:
+    """`python -m parallel_cnn_tpu plan show|diff` — the resolved
+    ExecutionPlan as a first-class object (docs/execution_plan.md).
+
+    `plan show [train flags] [--save PATH]` resolves exactly the plan a
+    train run with those flags would execute (flag > env > plan-file >
+    default) and prints it one knob per line with per-knob provenance;
+    `plan diff A B` prints a field-by-field diff of two plan files.
+    Both are pure host-side paths: no jax, no backend, no devices."""
+    from parallel_cnn_tpu import plan as plan_lib
+
+    if not argv or argv[0] not in ("show", "diff"):
+        print("usage: parallel_cnn_tpu plan show [train flags] "
+              "[--save PATH]\n"
+              "       parallel_cnn_tpu plan diff PLAN_A PLAN_B")
+        return 2
+    if argv[0] == "diff":
+        if len(argv) != 3:
+            print("usage: parallel_cnn_tpu plan diff PLAN_A PLAN_B")
+            return 2
+        try:
+            a = plan_lib.load_plan(argv[1])
+            b = plan_lib.load_plan(argv[2])
+        except plan_lib.PlanError as exc:
+            print(f"plan diff: {exc}")
+            return 2
+        out = plan_lib.diff_plans(a, b)
+        if not out:
+            print(f"plans identical ({a.fingerprint()})")
+            return 0
+        print(out)
+        return 1
+    p = build_parser()
+    p.add_argument("--save", default=None, metavar="PATH",
+                   help="also write the resolved plan as a --plan-loadable "
+                        "plan.json")
+    args = p.parse_args(argv[1:])
+    cfg = config_from_args(args)
+    plan = plan_lib.build_plan(cfg, args)
+    verdict = ""
+    try:
+        plan.validate()
+    except plan_lib.PlanError as exc:
+        verdict = f"\nILLEGAL: {exc}"
+    if args.save:
+        plan_lib.save_plan(args.save, plan)
+    print(plan_lib.format_plan(plan, title=f"resolved plan ({cfg.model})")
+          + verdict)
+    if args.save:
+        print(f"plan written to {args.save}")
+    return 1 if verdict else 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -1120,6 +1262,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _run_check(raw[1:])
     if raw and raw[0] == "tune":
         return _run_tune(raw[1:])
+    if raw and raw[0] == "plan":
+        return _run_plan(raw[1:])
     args = build_parser().parse_args(raw)
     cfg = config_from_args(args)
 
@@ -1322,9 +1466,9 @@ def _run_zoo(args: argparse.Namespace, cfg: Config) -> int:
     --mesh-data mesh (plus filter sharding with --mesh-model N>1), and
     --conv-backend pallas for the native kernels.
     """
+    from parallel_cnn_tpu import plan as plan_lib
     from parallel_cnn_tpu.data import synthetic
     from parallel_cnn_tpu.nn import cifar, resnet, vgg
-    from parallel_cnn_tpu.parallel import mesh as mesh_lib
     from parallel_cnn_tpu.resilience import ChaosMonkey
     from parallel_cnn_tpu.resilience import preempt
     from parallel_cnn_tpu.train import zoo
@@ -1356,56 +1500,21 @@ def _run_zoo(args: argparse.Namespace, cfg: Config) -> int:
         args.synthetic_test_count, seed=cfg.data.synthetic_seed + 1
     )
 
-    # Either mesh flag opts the zoo into GSPMD mesh training: --mesh-data
-    # alone is pure DP; --mesh-model N>1 additionally shards filters/
-    # channels (+ optimizer state + BN stats) over the model axis
-    # (parallel/zoo_sharding.py) — hybrid 2-D zoo training.
-    mesh = None
-    model_axis = (args.mesh_model or 1) > 1
-    hier = cfg.comm is not None and cfg.comm.impl == "hierarchical"
-    if cfg.pipeline is not None:
-        # The pipeline brings its own (stage, data) mesh over ALL
-        # devices; the flat mesh flags and the hierarchical (host,
-        # device) mesh don't describe it.
-        if args.mesh_data is not None or model_axis:
-            raise SystemExit(
-                "--pipeline-stages builds its own (stage, data) mesh "
-                "over all devices; drop --mesh-data/--mesh-model"
-            )
-        if hier:
-            raise SystemExit(
-                "pipeline gradients reduce over the flat data axis; "
-                "use --comm-impl ring (not hierarchical)"
-            )
-        mesh = mesh_lib.make_pipeline_mesh(cfg.pipeline.stages)
-        print(f"mesh: {dict(mesh.shape)} (pipeline)")
-    elif hier:
-        # The hierarchical path brings its own 2-level (host, device) mesh
-        # over ALL devices — the flat mesh flags don't describe it.
-        if args.mesh_data is not None or model_axis:
-            raise SystemExit(
-                "--comm-impl hierarchical builds its own (host, device) "
-                "mesh over all devices; drop --mesh-data/--mesh-model "
-                "(size the host axis with --comm-hosts)"
-            )
-        mesh = mesh_lib.make_hier_mesh(n_hosts=cfg.comm.hosts)
-        print(f"mesh: {dict(mesh.shape)} (hierarchical)")
-    elif args.mesh_data is not None or model_axis:
-        mesh = mesh_lib.make_mesh(
-            MeshConfig(data=args.mesh_data, model=args.mesh_model or 1)
-        )
-        print(f"mesh: {dict(mesh.shape)}")
-
-    if cfg.comm is not None and mesh is None:
-        raise SystemExit(
-            "--comm-impl/PCNN_COMM_* select the explicit mesh collective "
-            "path; add --mesh-data N (or --mesh-model)"
-        )
-    if cfg.comm is not None and model_axis:
-        raise SystemExit(
-            "--comm-impl is data-parallel only; model-axis sharding stays "
-            "on the GSPMD path (drop --mesh-model or --comm-impl)"
-        )
+    # ONE resolution + legality + mesh-construction site: the three
+    # historical mesh branches (flat ring / hierarchical / pipeline) and
+    # their ad-hoc knob guards all live in plan.build_plan / validate /
+    # make_mesh now (docs/execution_plan.md has the legality matrix).
+    try:
+        eplan = plan_lib.build_plan(cfg, args).validate()
+    except plan_lib.PlanError as exc:
+        raise SystemExit(str(exc))
+    mesh = eplan.make_mesh()
+    model_axis = eplan.model > 1
+    if mesh is not None:
+        kind = ("pipeline" if eplan.pipelined or eplan.stages > 1
+                else "hierarchical" if eplan.comm_impl == "hierarchical"
+                else None)
+        print(f"mesh: {dict(mesh.shape)}" + (f" ({kind})" if kind else ""))
 
     metrics = MetricsLogger(path=args.metrics) if args.metrics else None
     # batch-size sentinel: zoo default is minibatch 128; an explicit 1 is
@@ -1435,6 +1544,8 @@ def _run_zoo(args: argparse.Namespace, cfg: Config) -> int:
             model_axis=model_axis,
             comm=cfg.comm,
             fused=cfg.fused,
+            plan=eplan,
+            replan=args.replan,
             seed=args.seed,
             eval_data=(ev_imgs, ev_labels),
             checkpoint_dir=args.checkpoint_dir,

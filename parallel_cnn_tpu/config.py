@@ -925,3 +925,36 @@ class Config:
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
+
+
+#: Every PCNN_* variable that feeds an ExecutionPlan knob — the set
+#: plan.build_plan consults to label a knob's provenance "env".  Kept
+#: here (not in plan/) because environment reads live in config.py only
+#: (the env-outside-config graftcheck rule pins that).
+_PLAN_ENV_VARS = (
+    "PCNN_COMM_IMPL",
+    "PCNN_COMM_BUCKET_BYTES",
+    "PCNN_COMM_WIRE_DTYPE",
+    "PCNN_COMM_OVERLAP",
+    "PCNN_COMM_HOSTS",
+    "PCNN_FUSED_STEP",
+    "PCNN_ACT_DTYPE",
+    "PCNN_ZERO_LEVEL",
+    "PCNN_PIPELINE_STAGES",
+    "PCNN_PIPELINE_SPLIT",
+    "PCNN_PIPELINE_WIRE_DTYPE",
+    "PCNN_PIPELINE_ACT_DTYPE",
+    "PCNN_SERVE_PRECOMPILE",
+    "PCNN_SERVE_AOT_CACHE_DIR",
+)
+
+
+def present_plan_env() -> frozenset:
+    """The plan-feeding PCNN_* vars actually set in this environment."""
+    return frozenset(v for v in _PLAN_ENV_VARS if os.environ.get(v))
+
+
+def plan_path_from_env() -> Optional[str]:
+    """PCNN_PLAN: path to a plan.json applied under CLI flags (same
+    precedence slot as --plan; an explicit --plan flag wins), or None."""
+    return os.environ.get("PCNN_PLAN") or None

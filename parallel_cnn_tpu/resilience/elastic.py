@@ -110,10 +110,18 @@ class ElasticController:
         ring=None,
         obs: Optional["obs_lib.Obs"] = None,
         devices: Optional[Sequence] = None,
+        exec_plan=None,
     ):
         self.cfg = cfg
         self.world = world
         self.n_hosts = n_hosts
+        # The ExecutionPlan this run resolved (plan/). Resizes are
+        # expressed as plan derivation: derive_resized(plan, new_world)
+        # → make_mesh, so topology decisions live in ONE place and the
+        # trainer can key its recompile-once step cache on plan
+        # equality. Defaults to an empty plan (derivation only touches
+        # the topology fields).
+        self.exec_plan = exec_plan
         self.world0 = world  # scaling baseline for "per-device" policy
         self.chaos = chaos
         self.ring = ring
@@ -244,6 +252,7 @@ class ElasticController:
         match the new topology (ring ↔ hierarchical) with every other
         knob preserved.
         """
+        from parallel_cnn_tpu import plan as plan_lib
         from parallel_cnn_tpu.parallel import mesh as mesh_lib
         from parallel_cnn_tpu.train import zoo
 
@@ -278,9 +287,15 @@ class ElasticController:
             except Exception:
                 pass  # unreachable buffers fail in _snapshot, typed
             view, from_ring = self._snapshot(state, plan)
-            mesh = mesh_lib.make_elastic_mesh(
-                world, n_hosts=n_hosts, devices=self.devices
+            # The resize IS a plan derivation: the new topology is
+            # derive_resized(plan, world) and the mesh comes from THE
+            # mesh-construction site (plan.make_mesh), not a local
+            # constructor call.
+            new_exec_plan = plan_lib.derive_resized(
+                self.exec_plan or plan_lib.ExecutionPlan(),
+                world, n_hosts=n_hosts,
             )
+            mesh = new_exec_plan.make_mesh(devices=self.devices)
             has_host = mesh_lib.HOST_AXIS in mesh.axis_names
             new_comm = dataclasses.replace(
                 comm,
@@ -293,6 +308,7 @@ class ElasticController:
                 bucket_bytes=comm.bucket_bytes, n_host=new_hosts,
             )
         self.world, self.n_hosts = world, new_hosts
+        self.exec_plan = new_exec_plan
         self._template = view  # already host-side numpy
         ev = ResizeEvent(
             step=step, old_world=old_world, new_world=world,

@@ -574,8 +574,13 @@ def serve_stack(
     default one is built when ``cfg.admission`` is set (the SLO surface
     — serve/admission.py). ``cache_dir`` enables the engines'
     persistent AOT-executable cache (config.NetConfig.aot_cache_dir)."""
+    from parallel_cnn_tpu import plan as plan_lib
     from parallel_cnn_tpu.serve.engine import ReplicaPool
 
+    # The serving ExecutionPlan (plan/): eval sharding is replicated
+    # single-device, so the plan pins the compile/AOT policy, and its
+    # fingerprint keys the engines' on-disk executable cache.
+    splan = plan_lib.serve_plan(cfg, cache_dir=cache_dir)
     pool = ReplicaPool(
         handle,
         n_replicas=cfg.n_replicas,
@@ -585,6 +590,7 @@ def serve_stack(
         precompile=cfg.precompile,
         obs=obs,
         cache_dir=cache_dir,
+        plan_fingerprint=splan.fingerprint(),
     )
     if admission is None and getattr(cfg, "admission", False):
         from parallel_cnn_tpu.serve.admission import AdmissionController

@@ -184,6 +184,7 @@ class Engine:
         precompile: bool = False,
         obs: Optional["obs_lib.Obs"] = None,
         cache_dir: Optional[str] = None,
+        plan_fingerprint: Optional[str] = None,
     ):
         import jax
 
@@ -212,10 +213,16 @@ class Engine:
         # executable is a function of — an entry that does not match
         # EXACTLY falls back to recompile with a typed warning.
         self.cache_dir = cache_dir
+        self.plan_fingerprint = plan_fingerprint
         self._cache_ok = cache_dir is not None
         if self._cache_ok:
             os.makedirs(cache_dir, exist_ok=True)
             self._fingerprint = {
+                # The resolved ExecutionPlan's content fingerprint
+                # (plan/): serving under a different plan (AOT policy,
+                # eval sharding) must not reuse another plan's
+                # executables.
+                "plan": plan_fingerprint or "",
                 "jax": jax.__version__,
                 "backend": getattr(
                     getattr(self.device, "client", None),
@@ -502,6 +509,7 @@ class ReplicaPool:
         precompile: bool = False,
         obs: Optional["obs_lib.Obs"] = None,
         cache_dir: Optional[str] = None,
+        plan_fingerprint: Optional[str] = None,
     ):
         import jax
 
@@ -517,6 +525,7 @@ class ReplicaPool:
         self._precompile = precompile
         self.obs = obs
         self.cache_dir = cache_dir
+        self.plan_fingerprint = plan_fingerprint
         self.engines = [
             Engine(
                 handle,
@@ -527,6 +536,7 @@ class ReplicaPool:
                 precompile=precompile,
                 obs=obs,
                 cache_dir=cache_dir,
+                plan_fingerprint=plan_fingerprint,
             )
             for i in range(n_replicas)
         ]
@@ -593,6 +603,7 @@ class ReplicaPool:
             precompile=self._precompile,
             obs=self.obs,
             cache_dir=self.cache_dir,
+            plan_fingerprint=self.plan_fingerprint,
         )
         with self._lock:
             self.engines[i] = eng
@@ -625,6 +636,7 @@ class ReplicaPool:
             precompile=self._precompile,
             obs=self.obs,
             cache_dir=self.cache_dir,
+            plan_fingerprint=self.plan_fingerprint,
         )
         with self._lock:
             self.engines.append(eng)

@@ -71,13 +71,37 @@ def _flatten(params) -> Dict[str, np.ndarray]:
     return flat
 
 
-def _meta_for(state: TrainState) -> Dict[str, Any]:
-    return {
+def _meta_for(state: TrainState,
+              plan_fingerprint: Optional[str] = None) -> Dict[str, Any]:
+    meta = {
         "version": FORMAT_VERSION,
         "epoch": state.epoch,
         "epoch_errors": state.epoch_errors,
         "extra": state.extra,
     }
+    if plan_fingerprint:
+        meta["plan"] = plan_fingerprint
+    return meta
+
+
+def _check_plan(path: str, meta: Dict[str, Any],
+                plan_fingerprint: Optional[str], replan: bool) -> None:
+    """Refuse a checkpoint written under a different ExecutionPlan.
+
+    Only enforced when the reader supplies its live fingerprint; files
+    predating plan stamping (no "plan" key) always load. ``replan=True``
+    (the --replan flag, or the elastic reshard path — which recomputes
+    sharding from scratch anyway) waives the check.
+    """
+    if plan_fingerprint is None or replan:
+        return
+    stored = meta.get("plan")
+    if stored is not None and stored != plan_fingerprint:
+        from parallel_cnn_tpu.plan import PlanMismatchError
+
+        raise PlanMismatchError(
+            stored=stored, live=plan_fingerprint, path=path
+        )
 
 
 def _write_atomic(path: str, params, meta: Dict[str, Any]) -> None:
@@ -99,13 +123,22 @@ def _write_atomic(path: str, params, meta: Dict[str, Any]) -> None:
         raise
 
 
-def save(path: str, params, state: Optional[TrainState] = None) -> None:
-    """Atomically write params (+ train state) to `path` (.npz)."""
-    _write_atomic(path, params, _meta_for(state or TrainState()))
+def save(path: str, params, state: Optional[TrainState] = None, *,
+         plan_fingerprint: Optional[str] = None) -> None:
+    """Atomically write params (+ train state) to `path` (.npz).
+
+    ``plan_fingerprint`` stamps the ExecutionPlan the run resolved
+    (plan.ExecutionPlan.fingerprint()) into the metadata so restore can
+    refuse a checkpoint written under a different execution contract.
+    """
+    _write_atomic(
+        path, params, _meta_for(state or TrainState(), plan_fingerprint)
+    )
 
 
 def save_sharded(path: str, view, state: Optional[TrainState] = None, *,
-                 world_size: int, bucket_bytes: int) -> None:
+                 world_size: int, bucket_bytes: int,
+                 plan_fingerprint: Optional[str] = None) -> None:
     """Persist a ZeRO-3 training state (same atomic .npz format).
 
     ``view`` is the device-count-INDEPENDENT full view
@@ -118,7 +151,7 @@ def save_sharded(path: str, view, state: Optional[TrainState] = None, *,
     only), and the plain restore/load_params readers refuse the file with
     a typed error instead of mis-reading sharded state.
     """
-    meta = _meta_for(state or TrainState())
+    meta = _meta_for(state or TrainState(), plan_fingerprint)
     meta["zero3"] = {
         "world_size": world_size,
         "bucket_bytes": bucket_bytes,
@@ -187,17 +220,21 @@ def _reject_sharded(path: str, meta: Dict[str, Any], reader: str) -> None:
         )
 
 
-def restore(path: str, like) -> Tuple[Any, TrainState]:
+def restore(path: str, like, *, plan_fingerprint: Optional[str] = None,
+            replan: bool = False) -> Tuple[Any, TrainState]:
     """Load a checkpoint into the structure of `like` (a params pytree).
 
     Validates that the stored keys/shapes/dtypes exactly match `like` —
     a renamed layer or changed shape is a hard error, not a silent
     partial load. Damage and version skew raise the typed ValueError of
     `_read_arrays`; a ZeRO-3 sharded checkpoint raises the typed
-    "use restore_sharded" error.
+    "use restore_sharded" error. When ``plan_fingerprint`` is given, a
+    checkpoint stamped with a DIFFERENT plan raises PlanMismatchError
+    naming both fingerprints (``replan=True`` waives the check).
     """
     stored, meta = _read_arrays(path)
     _reject_sharded(path, meta, "restore")
+    _check_plan(path, meta, plan_fingerprint, replan)
 
     want = _flatten(like)
     if set(stored) != set(want):
@@ -218,7 +255,9 @@ def restore(path: str, like) -> Tuple[Any, TrainState]:
     return params, state
 
 
-def load_params(path: str, like):
+def load_params(path: str, like, *,
+                plan_fingerprint: Optional[str] = None,
+                replan: bool = False):
     """Inference-only restore: the subtree of `like` out of a checkpoint,
     without the TrainState.
 
@@ -239,6 +278,7 @@ def load_params(path: str, like):
     """
     stored, meta = _read_arrays(path)
     _reject_sharded(path, meta, "load_params")
+    _check_plan(path, meta, plan_fingerprint, replan)
     want = _flatten(like)
     missing = set(want) - set(stored)
     if missing:
@@ -249,7 +289,10 @@ def load_params(path: str, like):
     return _unflatten_into(like, stored)
 
 
-def restore_sharded(path: str, like) -> Tuple[Any, TrainState, Dict[str, Any]]:
+def restore_sharded(path: str, like, *,
+                    plan_fingerprint: Optional[str] = None,
+                    replan: bool = False,
+                    ) -> Tuple[Any, TrainState, Dict[str, Any]]:
     """Load a ZeRO-3 sharded checkpoint's full view into the structure of
     ``like`` (a zero3_full_view-shaped pytree).
 
@@ -264,6 +307,7 @@ def restore_sharded(path: str, like) -> Tuple[Any, TrainState, Dict[str, Any]]:
     reports WHICH rank's checkpoint failed instead of a bare KeyError.
     """
     stored, meta = _read_arrays(path)
+    _check_plan(path, meta, plan_fingerprint, replan)
     if not meta.get("zero3"):
         raise ShardedCheckpointError(
             "not a sharded checkpoint (no zero3 metadata) — "

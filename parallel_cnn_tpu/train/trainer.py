@@ -76,7 +76,22 @@ def _maybe_mesh(cfg: Config):
             "mesh training is the minibatch throughput mode; batch_size=1 "
             "strict parity is inherently sequential and single-device"
         )
-    mesh = mesh_lib.make_mesh(mc)
+    # Mesh construction routes through the ExecutionPlan — the single
+    # resolution site (plan.build_plan → plan.make_mesh); no direct
+    # mesh_lib constructor calls here.
+    from parallel_cnn_tpu import plan as plan_lib
+
+    eplan = plan_lib.build_plan(cfg).validate()
+    mesh = eplan.make_mesh()
+    if mesh is None:
+        return None
+    if (mesh_lib.DATA_AXIS not in mesh.axis_names
+            or mesh_lib.MODEL_AXIS not in mesh.axis_names):
+        raise ValueError(
+            "the reference trainer drives a flat (data, model) mesh only; "
+            f"the resolved plan built axes {tuple(mesh.axis_names)} — "
+            "drop the pipeline/hierarchical knobs for this model"
+        )
     if tc.ops == "pallas" and mesh.shape[mesh_lib.MODEL_AXIS] > 1:
         raise ValueError(
             "ops='pallas' composes with the data axis only (the fused "

@@ -1228,6 +1228,8 @@ def train(
     obs: Optional["obs_lib.Obs"] = None,
     elastic=None,
     pipeline=None,
+    plan=None,
+    replan: bool = False,
 ):
     """Epoch driver for zoo models on an in-memory dataset.
 
@@ -1318,6 +1320,17 @@ def train(
       the next epoch boundary (the epoch's batch generator is fixed-size
       mid-epoch).
 
+    - ``plan`` (a plan.ExecutionPlan): the resolved execution contract
+      this run trains under. Its fingerprint is stamped into every
+      checkpoint so resume refuses files written under a different
+      contract (``replan=True`` — the CLI's ``--replan`` — waives the
+      check; the elastic reshard path is exempt by construction). Under
+      elastic training the plan also gates recompile-once: resizes
+      derive a new plan via ``plan.derive_resized``, and plan-equality
+      keys a jitted-step cache, so resizing back to a previously seen
+      topology reuses the compiled step instead of re-jitting
+      (journaled as ``plan_step_cache`` hit/miss).
+
     - ``pipeline`` (a config.PipelineConfig; requires a
       mesh.make_pipeline_mesh (stage, data) mesh): 1F1B microbatch
       pipelining (train/pipeline_schedule.py) — model layers partition
@@ -1342,6 +1355,10 @@ def train(
     # outcomes, sentinel verdicts, and the comm bucket plan. The default
     # NOOP bundle makes all of it free.
     obs = obs if obs is not None else obs_lib.NOOP
+    # The resolved ExecutionPlan (plan/) travels under a distinct name:
+    # `z3_plan` below is the ZeRO-3 *bucket* plan, a different object.
+    exec_plan = plan
+    _plan_fp = exec_plan.fingerprint() if exec_plan is not None else None
     steps = images.shape[0] // batch_size
     if steps == 0:
         raise ValueError(
@@ -1559,6 +1576,16 @@ def train(
                     path, zero3_full_view(st, z3_plan, n_host=z3_host),
                     tstate, world_size=z3_plan.shards,
                     bucket_bytes=comm.bucket_bytes,
+                    plan_fingerprint=_plan_fp,
+                )
+        elif _plan_fp:
+            from parallel_cnn_tpu.train import checkpoint
+
+            def saver(path, st, tstate):
+                # Stamp the plan fingerprint so restore refuses files
+                # written under a different execution contract.
+                checkpoint.save(
+                    path, st, tstate, plan_fingerprint=_plan_fp
                 )
 
         ring = CheckpointRing(
@@ -1579,7 +1606,14 @@ def train(
                 # and re-shard it for THIS run's mesh (reshard-on-restore
                 # — the writing run's world size is irrelevant).
                 template = zero3_full_view(state, z3_plan, n_host=z3_host)
-                view, tstate, _ = checkpoint.restore_sharded(path, template)
+                # The elastic reshard path recomputes sharding from the
+                # world-size-independent view anyway — exempt from the
+                # plan-fingerprint gate (ring files written after a
+                # resize carry the derived plan's fingerprint).
+                view, tstate, _ = checkpoint.restore_sharded(
+                    path, template, plan_fingerprint=_plan_fp,
+                    replan=replan or (elastic is not None and elastic.enabled),
+                )
                 state, z3_plan = zero3_from_view(
                     view, n_data=mesh.shape[DATA_AXIS],
                     bucket_bytes=comm.bucket_bytes, n_host=z3_host,
@@ -1587,7 +1621,9 @@ def train(
             else:
                 # `state` is the restore template: full-state structure
                 # (params + opt_state + BN stats) validated leaf-for-leaf.
-                state, tstate = checkpoint.restore(path, state)
+                state, tstate = checkpoint.restore(
+                    path, state, plan_fingerprint=_plan_fp, replan=replan
+                )
             start_epoch = tstate.epoch
             losses = list(tstate.epoch_errors)
             accs = list(tstate.extra.get("epoch_accs", []))
@@ -1604,11 +1640,24 @@ def train(
         # independent, so it never goes stale across resizes).
         elastic_ctl = ElasticController(
             elastic, world=z3_plan.shards, n_hosts=z3_host,
-            chaos=chaos, ring=ring, obs=obs,
+            chaos=chaos, ring=ring, obs=obs, exec_plan=exec_plan,
         )
         elastic_ctl.register_template(
             zero3_full_view(state, z3_plan, n_host=z3_host)
         )
+    # Recompile-once across elastic resizes: jitted steps keyed by the
+    # (hashable) derived ExecutionPlan + LR. Primed with the initial
+    # topology's derived plan so resizing BACK to the starting world is
+    # a cache hit — derive_resized is deterministic, so equal topology
+    # ⟹ equal plan ⟹ same jitted step.
+    _step_cache: dict = {}
+    if elastic_ctl is not None and exec_plan is not None:
+        from parallel_cnn_tpu import plan as plan_lib
+
+        _step_cache[
+            (plan_lib.derive_resized(
+                exec_plan, z3_plan.shards, n_hosts=z3_host), lr)
+        ] = step
 
     n = images.shape[0]
     if loader == "native":
@@ -1677,12 +1726,40 @@ def train(
                         comm=comm,
                     )
                     z3_host = elastic_ctl.n_hosts
-                    step = make_zero3_train_step(
-                        model, lr=elastic_ctl.lr_for(lr),
-                        momentum=momentum, accum_steps=accum_steps,
-                        mesh=mesh, augment=aug_fn, comm=comm,
-                        fused=fused, plan=z3_plan,
-                    )
+                    # Plan-equality gates recompile-once: the resized
+                    # topology maps to a derived ExecutionPlan, and an
+                    # equal plan (same world/hosts/comm) at the same LR
+                    # reuses the step jitted the first time we were
+                    # here instead of re-tracing.
+                    _ckey = None
+                    if exec_plan is not None:
+                        from parallel_cnn_tpu import plan as plan_lib
+
+                        _ckey = (
+                            plan_lib.derive_resized(
+                                exec_plan, z3_plan.shards,
+                                n_hosts=z3_host,
+                            ),
+                            elastic_ctl.lr_for(lr),
+                        )
+                        if obs.enabled:
+                            obs.event(
+                                "plan_step_cache",
+                                hit=_ckey in _step_cache,
+                                plan=_ckey[0].fingerprint(),
+                                world=z3_plan.shards,
+                            )
+                    if _ckey is not None and _ckey in _step_cache:
+                        step = _step_cache[_ckey]
+                    else:
+                        step = make_zero3_train_step(
+                            model, lr=elastic_ctl.lr_for(lr),
+                            momentum=momentum, accum_steps=accum_steps,
+                            mesh=mesh, augment=aug_fn, comm=comm,
+                            fused=fused, plan=z3_plan,
+                        )
+                        if _ckey is not None:
+                            _step_cache[_ckey] = step
                     # Re-home the epoch accumulator: it is committed to
                     # the pre-resize devices, and mixing meshes in one
                     # add is an error. One host sync, inside the quiesce
